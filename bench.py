@@ -1,0 +1,102 @@
+"""Headline benchmark: ImageNet ResNet-50 training-step throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the full compiled training iteration (forward, CE loss, backward,
+gradient pmean, SyncBN stats, SGD+momentum+coupled-WD update — the whole
+reference hot loop, train_distributed.py:267-299, as one XLA program) on
+synthetic on-device data, so it isolates accelerator throughput exactly the
+way DDP images/sec is usually quoted.
+
+Precision: bf16 compute with fp32 master weights and fp32 BN statistics —
+the TPU-native mixed-precision mode (BASELINE.json config #4); set
+BENCH_DTYPE=float32 for the fp32 reference recipe.
+
+Baseline: 2300 images/sec/chip — A100-80GB ResNet-50 v1.5 DDP training with
+AMP (NVIDIA DeepLearningExamples published numbers), the "A100-DDP parity"
+bar from BASELINE.md.  vs_baseline = value / baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+A100_DDP_IMG_PER_SEC = 2300.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import (
+        build_train_step,
+        init_train_state,
+    )
+    from pytorch_distributed_training_tpu.models import get_model
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import (
+        DATA_AXIS,
+        batch_sharding,
+        make_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+    dtype_name = os.environ.get("BENCH_DTYPE", "bfloat16")
+    dtype = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype_name]
+    per_chip_batch = int(os.environ.get("BENCH_BATCH", "128"))
+    n_chips = jax.device_count()
+    sync_bn = n_chips > 1
+
+    mesh = make_mesh()
+    model = get_model(
+        "ResNet50", num_classes=1000,
+        axis_name=DATA_AXIS if sync_bn else None, dtype=dtype,
+    )
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.1, [150000, 300000], 0.1)
+    state = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3))
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    train_step = build_train_step(model, opt, lr_fn, mesh, sync_bn=sync_bn)
+
+    batch = per_chip_batch * n_chips
+    rng = np.random.default_rng(0)
+    img = jax.device_put(
+        rng.standard_normal((batch, 224, 224, 3)).astype(np.float32),
+        batch_sharding(mesh, 4),
+    )
+    label = jax.device_put(
+        rng.integers(0, 1000, (batch,)).astype(np.int32), batch_sharding(mesh, 1)
+    )
+
+    # warmup: compile + 2 steps
+    for _ in range(3):
+        state, loss = train_step(state, img, label)
+    jax.block_until_ready(loss)
+
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = train_step(state, img, label)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec_chip = batch * iters / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": f"ResNet-50 train images/sec/chip ({dtype_name}, batch {per_chip_batch}/chip)",
+                "value": round(img_per_sec_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(img_per_sec_chip / A100_DDP_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

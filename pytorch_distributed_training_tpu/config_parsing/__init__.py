@@ -1,0 +1,112 @@
+"""Config + logging + TensorBoard factories.
+
+Re-provides the ``dl_lib.config_parsing`` surface pinned by the reference at
+train_distributed.py:29 and :56-74:
+
+  - ``get_cfg(path) -> dict``         (YAML load, nested-dict access)
+  - ``get_train_logger(logdir=..., filename=...) -> logging.Logger``
+  - ``get_tb_writer(log_dir, file_name_cfg) -> SummaryWriter``
+
+The YAML schema is the reference's exactly (config/ResNet50.yml:1-31):
+``dataset / training / validation / model`` sections, including the *dead*
+``validation:`` section (never read by the engine — the val loader reuses
+training batch/workers, train_distributed.py:235-241) and the optional warmup
+keys under ``lr_schedule``.  We add explicit validation with
+exact-parity behavior: missing required keys raise (the reference's plain
+``dict[...]`` access would KeyError too), unknown keys are allowed.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any, Dict
+
+import yaml
+
+__all__ = ["get_cfg", "get_train_logger", "get_tb_writer", "validate_cfg", "TB_SUBDIR"]
+
+# TensorBoard events live under <log_dir>/tf-board-logs: the reference's crash
+# handler intends to delete exactly this subdirectory (train_distributed.py:82;
+# buggy there — 2nd rmtree arg — we implement the intent).
+TB_SUBDIR = "tf-board-logs"
+
+# Required keys, mirroring every cfg[...] access in the reference engine
+# (train_distributed.py:172-241, :251-299).
+_REQUIRED = {
+    "dataset": ["name", "root", "n_classes"],
+    "training": [
+        "optimizer",
+        "lr_schedule",
+        "train_iters",
+        "print_interval",
+        "val_interval",
+        "batch_size",
+        "num_workers",
+        "sync_bn",
+    ],
+    "model": ["name"],
+}
+
+
+def validate_cfg(cfg: Dict[str, Any], path: str = "<cfg>") -> Dict[str, Any]:
+    """Validate the reference schema; raises ``KeyError`` with a helpful path."""
+    for section, keys in _REQUIRED.items():
+        if section not in cfg:
+            raise KeyError(f"{path}: missing required section '{section}'")
+        for key in keys:
+            if key not in cfg[section]:
+                raise KeyError(f"{path}: missing required key '{section}.{key}'")
+    if "name" not in cfg["training"]["optimizer"]:
+        raise KeyError(f"{path}: missing required key 'training.optimizer.name'")
+    if "name" not in cfg["training"]["lr_schedule"]:
+        raise KeyError(f"{path}: missing required key 'training.lr_schedule.name'")
+    return cfg
+
+
+def get_cfg(cfg_filepath: str) -> Dict[str, Any]:
+    """Load + validate a YAML config (reference: train_distributed.py:64)."""
+    with open(cfg_filepath, "r") as fp:
+        cfg = yaml.safe_load(fp)
+    return validate_cfg(cfg, cfg_filepath)
+
+
+def get_train_logger(logdir: str, filename: str, mode: str = "a") -> logging.Logger:
+    """Root training logger with file + console handlers.
+
+    Reference contract (train_distributed.py:56-60): constructed once by the
+    log listener; all worker records are serialized through it.  The log file
+    is ``<logdir>/<filename>.log``.
+    """
+    os.makedirs(logdir, exist_ok=True)
+    logger = logging.getLogger("train")
+    logger.setLevel(logging.INFO)
+    # Idempotent: repeated construction (e.g. in tests) must not stack handlers.
+    logger.handlers.clear()
+    fmt = logging.Formatter(
+        "%(asctime)s - %(name)s - %(levelname)s - %(message)s"
+    )
+    fh = logging.FileHandler(os.path.join(logdir, f"{filename}.log"), mode=mode)
+    fh.setFormatter(fmt)
+    logger.addHandler(fh)
+    ch = logging.StreamHandler(sys.stdout)
+    ch.setFormatter(fmt)
+    logger.addHandler(ch)
+    logger.propagate = False
+    return logger
+
+
+def get_tb_writer(log_dir: str, file_name_cfg: str):
+    """TensorBoard ``SummaryWriter`` under ``<log_dir>/tf-board-logs/<name>``.
+
+    Reference contract (train_distributed.py:74, :163-164): rank-0 only; scalar
+    tags written by the engine are exactly ``loss/train``, ``lr_group/{i}``,
+    ``eval/Acc@1``, ``eval/Acc@5``, ``eval/loss`` (:295-297, :329-331).
+    """
+    path = os.path.join(log_dir, TB_SUBDIR, file_name_cfg)
+    os.makedirs(path, exist_ok=True)
+    try:
+        from tensorboardX import SummaryWriter
+    except ImportError:  # pragma: no cover
+        from torch.utils.tensorboard import SummaryWriter
+    return SummaryWriter(path)

@@ -1,0 +1,28 @@
+"""Input pipeline: datasets, index-space sharding, prefetching loader.
+
+Re-provides the reference's data surface — ``get_dataset`` (dl_lib,
+train_distributed.py:26), ``DistributedSampler``-equivalent sharding
+(:213-222) and a prefetching ``DataLoader`` (:227-241) — re-designed for a
+one-process-per-host TPU runtime (see sampler.py / loader.py docstrings).
+"""
+from .datasets import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ImageFolderDataset,
+    SyntheticDataset,
+    get_dataset,
+)
+from .loader import DataLoader
+from .sampler import DistributedShardSampler, RandomSampler, SequentialSampler
+
+__all__ = [
+    "get_dataset",
+    "SyntheticDataset",
+    "ImageFolderDataset",
+    "DataLoader",
+    "DistributedShardSampler",
+    "RandomSampler",
+    "SequentialSampler",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+]

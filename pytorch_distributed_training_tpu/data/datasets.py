@@ -1,0 +1,192 @@
+"""Datasets.
+
+Re-provides ``dl_lib.classification.data.get_dataset`` (reference import at
+train_distributed.py:26, calls at :171-181): ``get_dataset(name, root, split)``
+with ``split in {"train", "val"}``, returning a map-style dataset of
+``(image, label)`` samples.
+
+Names:
+  - ``imagenet``  — ImageFolder layout (``<root>/train/<wnid>/*.JPEG``,
+    ``<root>/val/<wnid>/*.JPEG``), torchvision-recipe transforms
+    (RandomResizedCrop(224)+flip for train, Resize(256)+CenterCrop(224) for
+    val, ImageNet mean/std normalization).  The exact dl_lib transforms are
+    unobservable (library not mounted); this is the standard recipe the
+    reference's accuracy table assumes (SURVEY.md §7 hard part #3).
+  - ``synthetic`` — deterministic random 224x224 images; the smoke-test /
+    benchmarking dataset (BASELINE.json config #1 names "synthetic 224x224
+    batch"), shaped like ImageNet but with zero host I/O cost.
+
+TPU-native notes: samples are NHWC float32 (or uint8 pre-normalize), the
+layout XLA:TPU convolutions want; decode/augment runs on host CPU inside the
+loader's worker threads (see loader.py).
+"""
+from __future__ import annotations
+
+import os
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "get_dataset",
+    "SyntheticDataset",
+    "ImageFolderDataset",
+    "IMAGENET_MEAN",
+    "IMAGENET_STD",
+]
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+class SyntheticDataset:
+    """Deterministic fake ImageNet: class-dependent Gaussian images.
+
+    Each sample is reproducible from its index alone, so the dataset behaves
+    identically across hosts/ranks without any shared storage — the property
+    the smoke config needs (SURVEY.md §4: "synthetic dataset" integration
+    target).  Images carry class-dependent signal (mean shift per class) so
+    short training runs have learnable structure and loss visibly decreases.
+    """
+
+    def __init__(
+        self,
+        n_samples: int = 1280,
+        n_classes: int = 1000,
+        image_size: int = 224,
+        split: str = "train",
+        seed: int = 0,
+    ):
+        self.n_samples = int(n_samples)
+        self.n_classes = int(n_classes)
+        self.image_size = int(image_size)
+        # different split -> disjoint sample streams; crc32 (not hash()) so
+        # the salt is identical across processes/hosts regardless of
+        # PYTHONHASHSEED — required for the "same dataset on every host"
+        # premise of distributed sharding.
+        self._salt = (zlib.crc32(split.encode()) & 0xFFFF) ^ seed
+
+    def __len__(self) -> int:
+        return self.n_samples
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
+        rng = np.random.default_rng(self._salt * 1_000_003 + idx)
+        label = idx % self.n_classes
+        img = rng.standard_normal(
+            (self.image_size, self.image_size, 3), dtype=np.float32
+        )
+        # class-dependent mean shift: learnable but not trivially separable
+        img += 0.1 * ((label % 16) - 8) / 8.0
+        return img, np.int64(label)
+
+
+class ImageFolderDataset:
+    """``<root>/<split>/<class_dir>/<image>`` layout, torchvision semantics.
+
+    Class indices are assigned by sorted class-dir name (torchvision
+    ``ImageFolder`` parity — required for val accuracy comparability).
+    Decoding uses PIL; transforms follow the standard ImageNet recipe.
+    """
+
+    def __init__(self, root: str, split: str, image_size: int = 224, train_transform: Optional[bool] = None):
+        self.root = os.path.expanduser(root)
+        self.split = split
+        self.image_size = image_size
+        self.train = train_transform if train_transform is not None else (split == "train")
+        split_dir = os.path.join(self.root, split)
+        if not os.path.isdir(split_dir):
+            raise FileNotFoundError(f"dataset split dir not found: {split_dir}")
+        classes = sorted(
+            d for d in os.listdir(split_dir) if os.path.isdir(os.path.join(split_dir, d))
+        )
+        if not classes:
+            raise FileNotFoundError(f"no class directories under {split_dir}")
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples: List[Tuple[str, int]] = []
+        for c in classes:
+            cdir = os.path.join(split_dir, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(_IMG_EXTS):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
+        from PIL import Image
+
+        path, label = self.samples[idx]
+        with Image.open(path) as im:
+            im = im.convert("RGB")
+            if self.train:
+                im = _random_resized_crop(im, self.image_size)
+                if np.random.random() < 0.5:
+                    im = im.transpose(Image.FLIP_LEFT_RIGHT)
+            else:
+                im = _resize_center_crop(im, self.image_size)
+            arr = np.asarray(im, dtype=np.float32) / 255.0
+        arr = (arr - IMAGENET_MEAN) / IMAGENET_STD
+        return arr, np.int64(label)
+
+
+def _random_resized_crop(im, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+    """torchvision RandomResizedCrop semantics (10 attempts then center fallback)."""
+    from PIL import Image
+
+    w, h = im.size
+    area = w * h
+    for _ in range(10):
+        target_area = area * np.random.uniform(*scale)
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(np.random.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x = np.random.randint(0, w - cw + 1)
+            y = np.random.randint(0, h - ch + 1)
+            return im.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
+    return _resize_center_crop(im, size)
+
+
+def _resize_center_crop(im, size: int, resize_to: int = 256):
+    from PIL import Image
+
+    w, h = im.size
+    scale = resize_to / min(w, h)
+    im = im.resize((max(1, round(w * scale)), max(1, round(h * scale))), Image.BILINEAR)
+    w, h = im.size
+    x = (w - size) // 2
+    y = (h - size) // 2
+    return im.crop((x, y, x + size, y + size))
+
+
+def get_dataset(
+    name: str,
+    root: str,
+    split: str,
+    n_classes: Optional[int] = None,
+    image_size: int = 224,
+    n_samples: Optional[int] = None,
+):
+    """Dataset factory (reference: train_distributed.py:171-181).
+
+    ``n_classes`` / ``image_size`` / ``n_samples`` parameterize the synthetic
+    dataset (the engine forwards optional ``dataset.image_size`` /
+    ``dataset.n_samples`` config keys — additive, unknown to the reference
+    schema but ignored there).
+    """
+    name = name.lower()
+    if name in ("synthetic", "fake", "fake_imagenet"):
+        n = n_samples if n_samples else (12_800 if split == "train" else 1_280)
+        return SyntheticDataset(
+            n_samples=n,
+            n_classes=n_classes or 1000,
+            image_size=image_size,
+            split=split,
+        )
+    if name == "imagenet":
+        return ImageFolderDataset(root, split, image_size=image_size)
+    raise KeyError(f"unknown dataset '{name}' (have: imagenet, synthetic)")

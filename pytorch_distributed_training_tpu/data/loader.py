@@ -1,0 +1,123 @@
+"""Host-side batched loader with threaded decode + prefetch.
+
+The TPU-native replacement for ``torch.utils.data.DataLoader`` with worker
+processes and pinned memory (reference: train_distributed.py:227-241,
+SURVEY.md §2.3): JAX keeps one controller process per host, so parallel
+decode/augment runs in a thread pool (PIL decode and numpy augment release
+the GIL for the heavy parts) and batches are prefetched into a bounded queue
+so host I/O overlaps device compute — the role pinned memory + ``non_blocking``
+H2D copies play in the reference (:272-273).  Device placement itself happens
+in the engine (``jax.device_put`` with the batch sharding), double-buffered
+by this queue.
+
+Batch-shape policy (XLA static shapes — SURVEY.md §7 design stance):
+  - ``drop_last=True`` (train): only full batches are yielded; with the
+    sampler's ``drop_last`` this mirrors the reference's equal-per-rank
+    training stream, minus at most one partial batch per epoch that torch
+    would have yielded (deviation documented; it avoids one extra XLA
+    compilation and a ragged global batch across hosts).
+  - ``drop_last=False`` (val): the final partial batch is padded by wrapping
+    to a full batch, and every rank yields the same batch count — the same
+    "tail may double-count" semantics the reference's val path already has
+    via DistributedSampler padding (train_distributed.py:219-222).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .sampler import DistributedShardSampler
+
+__all__ = ["DataLoader"]
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sampler: DistributedShardSampler,
+        num_workers: int = 0,
+        drop_last: bool = False,
+        prefetch_batches: int = 2,
+    ):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.sampler = sampler
+        self.num_workers = int(num_workers)
+        self.drop_last = bool(drop_last)
+        self.prefetch_batches = max(1, int(prefetch_batches))
+
+    def set_epoch(self, epoch: int) -> None:
+        self.sampler.set_epoch(epoch)
+
+    def _batch_indices(self) -> list:
+        idx = self.sampler.local_indices()
+        n = len(idx)
+        batches = []
+        for start in range(0, n, self.batch_size):
+            chunk = idx[start : start + self.batch_size]
+            if len(chunk) < self.batch_size:
+                if self.drop_last:
+                    break
+                # wrap-pad the tail, tiling if the shard is smaller than a batch
+                chunk = np.resize(np.concatenate([chunk, idx]), self.batch_size)
+            batches.append(chunk)
+        return batches
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _assemble(self, indices: np.ndarray, pool: Optional[ThreadPoolExecutor]):
+        if pool is not None:
+            samples = list(pool.map(self.dataset.__getitem__, indices))
+        else:
+            samples = [self.dataset[i] for i in indices]
+        imgs = np.stack([s[0] for s in samples])
+        labels = np.asarray([s[1] for s in samples], dtype=np.int64)
+        return imgs, labels
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        batches = self._batch_indices()
+        if not batches:
+            return
+        pool = ThreadPoolExecutor(self.num_workers) if self.num_workers > 0 else None
+        out_q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for b in batches:
+                    if stop.is_set():
+                        return
+                    out_q.put(self._assemble(b, pool))
+                out_q.put(None)
+            except BaseException as e:  # surface worker errors to the consumer
+                out_q.put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # drain so the producer can exit
+            while t.is_alive():
+                try:
+                    out_q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=1.0)
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)

@@ -1,0 +1,95 @@
+"""Index-space sharding (the ``DistributedSampler`` capability).
+
+The reference relies on ``torch.utils.data.DistributedSampler``
+(train_distributed.py:22, :213-222) — a first-class parallelism primitive
+(SURVEY.md §2.3): per-rank disjoint index shards, per-epoch reshuffle, train
+``drop_last`` and val tail-padding.  This module re-provides those semantics
+for a one-process-per-host JAX runtime: each *host* takes the union of its
+devices' shards (the engine splits the host batch across local devices via
+sharding, so the sampler shards by host, not by chip).
+
+Parity notes (vs torch DistributedSampler):
+  - ``drop_last=True``: per-rank count = floor(len / num_replicas); the
+    surplus tail is dropped (same).
+  - ``drop_last=False``: indices padded by wrapping from the start so all
+    ranks get equal counts (same double-count-the-tail semantics).
+  - shuffle: permutation seeded by ``seed + epoch`` (same re-randomization
+    structure; the exact permutation differs from torch's randperm — the
+    reference never pins RNG streams across frameworks).
+  - rank r takes ``indices[r::num_replicas]`` (torch's interleaved
+    assignment).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+__all__ = ["DistributedShardSampler", "RandomSampler", "SequentialSampler"]
+
+
+class DistributedShardSampler:
+    def __init__(
+        self,
+        dataset_len: int,
+        num_replicas: int,
+        rank: int,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        if not (0 <= rank < num_replicas):
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset_len = int(dataset_len)
+        self.num_replicas = int(num_replicas)
+        self.rank = int(rank)
+        self.shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        self.seed = int(seed)
+        self.epoch = 0
+
+        if self.drop_last:
+            self.num_samples = self.dataset_len // self.num_replicas
+        else:
+            self.num_samples = -(-self.dataset_len // self.num_replicas)  # ceil
+        self.total_size = self.num_samples * self.num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def _global_indices(self) -> np.ndarray:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.dataset_len)
+        else:
+            indices = np.arange(self.dataset_len)
+        if self.drop_last:
+            indices = indices[: self.total_size]
+        else:
+            pad = self.total_size - len(indices)
+            if pad > 0:
+                indices = np.concatenate([indices, indices[:pad]])
+        return indices
+
+    def local_indices(self) -> np.ndarray:
+        return self._global_indices()[self.rank :: self.num_replicas]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices().tolist())
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+class RandomSampler(DistributedShardSampler):
+    """Single-replica shuffled sampler (reference: train_distributed.py:224)."""
+
+    def __init__(self, dataset_len: int, seed: int = 0):
+        super().__init__(dataset_len, 1, 0, shuffle=True, drop_last=False, seed=seed)
+
+
+class SequentialSampler(DistributedShardSampler):
+    """Single-replica in-order sampler (reference: train_distributed.py:225)."""
+
+    def __init__(self, dataset_len: int):
+        super().__init__(dataset_len, 1, 0, shuffle=False, drop_last=False)

@@ -1,0 +1,15 @@
+"""Training engine: compiled SPMD steps + the Runner orchestration.
+
+Replaces the reference's L4/L6 layers (``Runner`` process orchestration and
+the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
+"""
+from .runner import Runner
+from .steps import TrainState, build_eval_step, build_train_step, init_train_state
+
+__all__ = [
+    "Runner",
+    "TrainState",
+    "build_train_step",
+    "build_eval_step",
+    "init_train_state",
+]

@@ -1,0 +1,164 @@
+"""Compiled SPMD train / eval steps.
+
+The TPU-native re-design of the reference's hot loop (SURVEY.md §3.2-3.3):
+``train_iter``'s zero_grad -> H2D -> forward -> CE -> backward (DDP bucketed
+allreduce) -> SGD step sequence (train_distributed.py:267-299) becomes ONE
+XLA program: forward, loss, backward, gradient ``pmean`` over the ICI data
+axis, BN-stats ``pmean`` (SyncBN), LR-schedule evaluation, and the SGD update
+are all traced together under ``jit`` + ``shard_map``, so XLA fuses the
+elementwise work into the matmuls and overlaps the gradient all-reduce with
+remaining backward compute — the scheduling DDP's C++ reducer does by hand.
+
+The per-step loss is ``pmean``-reduced in-graph (the reference's explicit
+``dist.all_reduce(loss)/world_size``, :281-284) and returned as a device
+scalar; the host only syncs on it at ``print_interval`` (:280), so steady-state
+iterations never block on device->host transfers.
+
+Eval mirrors :301-321: loss + top-1/top-5 computed on-device and
+``pmean``-reduced (the reference's three per-batch ``all_reduce`` calls
+collapse into the compiled step).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..metrics import accuracy
+from ..ops import cross_entropy_loss
+from ..parallel.mesh import DATA_AXIS
+
+__all__ = ["TrainState", "build_train_step", "build_eval_step", "init_train_state"]
+
+
+class TrainState(struct.PyTreeNode):
+    """Replicated training state: params + BN running stats + optimizer state.
+
+    The reference's equivalents: module params/buffers on each replica (DDP
+    keeps them in sync via grad allreduce + buffer broadcast) and
+    ``optimizer.state`` (momentum buffers, train_distributed.py:207).  The
+    iteration counter lives in ``opt_state.step``.
+    """
+
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    @property
+    def step(self):
+        return self.opt_state.step
+
+
+def init_train_state(model, optimizer, rng, sample_input) -> TrainState:
+    """Same-seed replicated init — the DDP param broadcast (reference :198)
+    is redundant when every replica initializes from the same PRNGKey
+    (the reference already seeds all ranks identically, :141-142)."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+    )
+
+
+def build_train_step(
+    model,
+    optimizer,
+    lr_fn: Callable,
+    mesh: Mesh,
+    sync_bn: bool,
+    donate: bool = True,
+):
+    """Compile the full training iteration as one SPMD program.
+
+    Args:
+      model: a linen module whose ``apply`` takes ``(variables, img, train=...)``
+        and mutates ``batch_stats`` in train mode.  When ``sync_bn``, the model
+        must carry ``axis_name=DATA_AXIS`` so its BN layers ``pmean`` their
+        statistics (the reference's SyncBatchNorm conversion, :196-197).
+      optimizer: functional optimizer (``init``/``update``) from
+        :mod:`..optimizers`.
+      lr_fn: pure schedule ``lr(step)`` evaluated on-device (see
+        :mod:`..schedulers`).
+      sync_bn: whether BN stats are cross-replica (config ``training.sync_bn``).
+    """
+
+    def body(params, batch_stats, opt_state, img, label):
+        def loss_fn(p):
+            out, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                img,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(out, label), mutated["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # DDP-reducer equivalent: mean-reduce grads over the data axis.  XLA
+        # schedules this all-reduce concurrently with independent compute.
+        grads = jax.lax.pmean(grads, DATA_AXIS)
+        loss = jax.lax.pmean(loss, DATA_AXIS)
+        if not sync_bn:
+            # Local BN stats diverge per replica; average them so the state
+            # stays replicated (the reference's DDP broadcast_buffers keeps
+            # replicas in sync by broadcasting rank-0 — an averaging variant
+            # with the same fixed point; deviation documented in SURVEY §2.3).
+            new_bs = jax.lax.pmean(new_bs, DATA_AXIS)
+        lr = lr_fn(opt_state.step)
+        new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+        return new_params, new_bs, new_opt, loss
+
+    rep = P()
+    img_spec = P(DATA_AXIS, None, None, None)
+    label_spec = P(DATA_AXIS)
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, img_spec, label_spec),
+        out_specs=(rep, rep, rep, rep),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state: TrainState, img, label):
+        new_params, new_bs, new_opt, loss = sharded(
+            state.params, state.batch_stats, state.opt_state, img, label
+        )
+        return (
+            TrainState(params=new_params, batch_stats=new_bs, opt_state=new_opt),
+            loss,
+        )
+
+    return train_step
+
+
+def build_eval_step(model, mesh: Mesh):
+    """Compile the distributed validation step (reference :309-321)."""
+
+    def body(params, batch_stats, img, label):
+        out = model.apply(
+            {"params": params, "batch_stats": batch_stats}, img, train=False
+        )
+        loss = cross_entropy_loss(out, label)
+        acc1, acc5 = accuracy(out, label, topk=(1, 5))
+        # reference: all_reduce(SUM) then / world_size  ==  pmean
+        return jax.lax.pmean((loss, acc1, acc5), DATA_AXIS)
+
+    rep = P()
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, rep, P(DATA_AXIS, None, None, None), P(DATA_AXIS)),
+        out_specs=(rep, rep, rep),
+    )
+
+    @jax.jit
+    def eval_step(state: TrainState, img, label):
+        return sharded(state.params, state.batch_stats, img, label)
+
+    return eval_step

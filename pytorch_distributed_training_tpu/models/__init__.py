@@ -1,0 +1,48 @@
+"""Model zoo.
+
+Re-provides ``dl_lib.classification.models.get_model`` (reference import at
+train_distributed.py:25, call at :183-186): ``get_model(model_name,
+num_classes) -> model``.  Case-insensitive on the name; the reference configs
+use ``ResNet50`` (config/ResNet50.yml:31).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .resnet import RESNET_CONFIGS, BasicBlock, Bottleneck, ResNet
+
+__all__ = ["get_model", "list_models", "ResNet", "BasicBlock", "Bottleneck"]
+
+_CANONICAL = {name.lower(): name for name in RESNET_CONFIGS}
+
+
+def list_models():
+    return sorted(RESNET_CONFIGS)
+
+
+def get_model(
+    model_name: str,
+    num_classes: int,
+    axis_name: Optional[str] = None,
+    dtype: Any = jnp.float32,
+) -> ResNet:
+    """Build a model by zoo name (reference: train_distributed.py:183-186).
+
+    Extra TPU-native knobs beyond the reference signature (both keyword-only
+    in spirit; the engine wires them from config):
+      axis_name: mesh axis for SyncBN (``sync_bn: True`` => the data axis).
+      dtype: compute dtype (bf16 mixed precision).
+    """
+    key = model_name.lower()
+    if key not in _CANONICAL:
+        raise KeyError(f"unknown model '{model_name}' (have: {list_models()})")
+    block_cls, stage_sizes = RESNET_CONFIGS[_CANONICAL[key]]
+    return ResNet(
+        stage_sizes=stage_sizes,
+        block_cls=block_cls,
+        num_classes=num_classes,
+        axis_name=axis_name,
+        dtype=dtype,
+    )

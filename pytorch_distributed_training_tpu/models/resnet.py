@@ -1,0 +1,201 @@
+"""ResNet family (18/34/50/101/152) in Flax linen, TPU-native.
+
+Replaces the reference's ``dl_lib.classification.models.get_model`` zoo
+(import at train_distributed.py:25, names pinned by config/ResNet50.yml:31
+and the README accuracy table, README.md:7-13).  Built for the MXU:
+
+  - NHWC layout (TPU-native; the host pipeline emits NHWC, no transposes),
+  - all normalization via :class:`~..ops.batch_norm.DistributedBatchNorm`
+    so ``sync_bn`` is a constructor argument (``axis_name``), not a
+    post-hoc module-tree rewrite like ``convert_sync_batchnorm``
+    (train_distributed.py:196-197),
+  - optional bf16 compute dtype with fp32 params and fp32 BN statistics.
+
+Topology parity with torchvision ResNet v1.5 (the weights the reference's
+accuracy table describes): 7x7/2 stem + 3x3/2 maxpool; bottleneck blocks put
+the stride on the 3x3 conv; projection shortcuts are 1x1 conv + BN; explicit
+torch-style padding (flax "SAME" differs for stride-2 — we match torch).
+
+Init parity: convs use kaiming-normal fan_out (torch ``kaiming_normal_``
+with ``mode='fan_out', nonlinearity='relu'``); BN scale=1 offset=0
+(``zero_init_residual=False``, torchvision default); the classifier head
+uses torch ``nn.Linear`` default init (kaiming-uniform a=sqrt(5) ==
+U(+-1/sqrt(fan_in)) for both kernel and bias).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.batch_norm import DistributedBatchNorm
+
+__all__ = [
+    "ResNet",
+    "BasicBlock",
+    "Bottleneck",
+    "RESNET_CONFIGS",
+]
+
+# torch kaiming_normal_(mode="fan_out", nonlinearity="relu")
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def _torch_linear_kernel_init(key, shape, dtype):
+    """torch ``nn.Linear`` default: kaiming_uniform(a=sqrt(5)) == U(+-1/sqrt(fan_in))."""
+    fan_in = shape[0]
+    bound = 1.0 / math.sqrt(fan_in)
+    import jax.random as jrandom
+
+    return jrandom.uniform(key, shape, dtype, -bound, bound)
+
+
+def _torch_linear_bias_init(fan_in: int):
+    bound = 1.0 / math.sqrt(fan_in)
+
+    def init(key, shape, dtype):
+        import jax.random as jrandom
+
+        return jrandom.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs; stride on the first (torchvision BasicBlock)."""
+
+    features: int
+    stride: int
+    conv: Callable
+    norm: Callable
+
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x):
+        identity = x
+        out = self.conv(self.features, (3, 3), self.stride, name="conv1")(x)
+        out = self.norm(name="bn1")(out)
+        out = nn.relu(out)
+        out = self.conv(self.features, (3, 3), 1, name="conv2")(out)
+        out = self.norm(name="bn2")(out)
+        if self.stride != 1 or identity.shape[-1] != self.features:
+            identity = self.conv(self.features, (1, 1), self.stride, name="downsample_conv")(x)
+            identity = self.norm(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    """1x1 reduce -> 3x3 (stride here: v1.5) -> 1x1 expand (torchvision Bottleneck)."""
+
+    features: int
+    stride: int
+    conv: Callable
+    norm: Callable
+
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x):
+        out_features = self.features * self.expansion
+        identity = x
+        out = self.conv(self.features, (1, 1), 1, name="conv1")(x)
+        out = self.norm(name="bn1")(out)
+        out = nn.relu(out)
+        out = self.conv(self.features, (3, 3), self.stride, name="conv2")(out)
+        out = self.norm(name="bn2")(out)
+        out = nn.relu(out)
+        out = self.conv(out_features, (1, 1), 1, name="conv3")(out)
+        out = self.norm(name="bn3")(out)
+        if self.stride != 1 or identity.shape[-1] != out_features:
+            identity = self.conv(out_features, (1, 1), self.stride, name="downsample_conv")(x)
+            identity = self.norm(name="downsample_bn")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet(nn.Module):
+    """torchvision-topology ResNet with TPU-native distributed BN.
+
+    Args:
+      stage_sizes: blocks per stage, e.g. (3, 4, 6, 3) for ResNet-50.
+      block_cls: :class:`BasicBlock` or :class:`Bottleneck`.
+      num_classes: classifier width (reference: ``dataset.n_classes``).
+      axis_name: mesh axis for synchronized BN statistics (``sync_bn: True``),
+        or ``None`` for per-replica stats.
+      dtype: compute dtype (bf16 for mixed precision); params stay fp32.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: Any
+    num_classes: int
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def conv(features, kernel, stride, name):
+            pad = [(k // 2, k // 2) for k in kernel]
+            return nn.Conv(
+                features,
+                kernel,
+                strides=(stride, stride),
+                padding=pad,
+                use_bias=False,
+                kernel_init=conv_kernel_init,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=name,
+            )
+
+        norm = functools.partial(
+            DistributedBatchNorm,
+            use_running_average=not train,
+            axis_name=self.axis_name if train else None,
+            momentum=0.1,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+
+        x = x.astype(self.dtype)
+        x = conv(64, (7, 7), 2, name="conv1")(x)
+        x = norm(name="bn1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+
+        features = 64
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                stride = 2 if stage > 0 and block == 0 else 1
+                x = self.block_cls(
+                    features=features,
+                    stride=stride,
+                    conv=conv,
+                    norm=norm,
+                    name=f"layer{stage + 1}_{block}",
+                )(x)
+            features *= 2
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool (AdaptiveAvgPool2d(1))
+        fan_in = x.shape[-1]
+        x = nn.Dense(
+            self.num_classes,
+            kernel_init=_torch_linear_kernel_init,
+            bias_init=_torch_linear_bias_init(fan_in),
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="fc",
+        )(x)
+        return x.astype(jnp.float32)  # logits in fp32 for a stable loss
+
+
+# name -> (block, stage_sizes), torchvision families (README.md:7-13)
+RESNET_CONFIGS: dict[str, Tuple[Any, Tuple[int, ...]]] = {
+    "ResNet18": (BasicBlock, (2, 2, 2, 2)),
+    "ResNet34": (BasicBlock, (3, 4, 6, 3)),
+    "ResNet50": (Bottleneck, (3, 4, 6, 3)),
+    "ResNet101": (Bottleneck, (3, 4, 23, 3)),
+    "ResNet152": (Bottleneck, (3, 8, 36, 3)),
+}

@@ -1,0 +1,15 @@
+"""TPU-native neural-net ops.
+
+The reference gets these capabilities from PyTorch C++/CUDA natives
+(SURVEY.md §2.3); here they are first-party, built on XLA primitives:
+
+  - :mod:`.batch_norm` — ``DistributedBatchNorm``: cross-replica synchronized
+    batch normalization via in-graph ``lax.pmean`` (reference:
+    ``torch.nn.SyncBatchNorm`` C++/NCCL kernels, train_distributed.py:196-197).
+  - :mod:`.losses` — cross-entropy matching ``torch.nn.CrossEntropyLoss``
+    (train_distributed.py:202).
+"""
+from .batch_norm import DistributedBatchNorm
+from .losses import cross_entropy_loss
+
+__all__ = ["DistributedBatchNorm", "cross_entropy_loss"]

@@ -1,0 +1,101 @@
+"""Distributed (synchronized) batch normalization.
+
+The TPU-native re-design of ``torch.nn.SyncBatchNorm`` as exercised by the
+reference (train_distributed.py:16, :196-197, gated by ``sync_bn`` in
+config/ResNet50.yml:24).  Where the reference dispatches to C++/CUDA kernels
+plus an NCCL allreduce per BN layer per step, here the cross-replica mean /
+mean-of-squares reduction is a ``lax.pmean`` *inside* the compiled train
+step, so XLA schedules it on ICI together with everything else — no separate
+kernel launches, no Python in the loop.
+
+PyTorch-parity semantics (SURVEY.md §7 "hard parts" #2 — a wrong
+biased/unbiased choice silently costs top-1):
+
+  - normalization uses the **biased** batch variance (as torch does),
+  - running_var is updated with the **unbiased** variance ``var * n/(n-1)``
+    where ``n`` is the number of reduced elements — the **global** count
+    across replicas when ``axis_name`` is set, exactly like SyncBatchNorm,
+  - running stats update: ``r <- (1 - m) * r + m * stat`` with torch's
+    ``momentum = 0.1`` convention (note flax's BatchNorm uses the opposite
+    convention; this module uses torch's),
+  - with ``axis_name`` set, replicas compute identical stats, so running
+    stats stay replica-synced by construction (the reference gets this from
+    SyncBatchNorm's allreduce; without sync, DDP broadcast_buffers papers
+    over drift — see engine notes).
+
+Stats are always computed in float32 even for bf16 activations (torch
+autocast keeps BN in fp32; also required for variance accuracy on TPU).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["DistributedBatchNorm"]
+
+
+class DistributedBatchNorm(nn.Module):
+    """BatchNorm over the leading axes with optional cross-replica sync.
+
+    Args:
+      use_running_average: eval mode (normalize by running stats) vs train
+        mode (batch stats + running-stat update).
+      axis_name: mapped mesh axis to synchronize over (``lax.pmean``); ``None``
+        for per-replica (local) statistics.
+      momentum: torch-convention running-stat momentum (0.1 default).
+      epsilon: variance epsilon (torch default 1e-5).
+    """
+
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = None
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        features = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (features,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (features,), self.param_dtype)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        xf = x.astype(jnp.float32)
+        reduce_axes = tuple(range(x.ndim - 1))
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            local_n = 1
+            for ax in reduce_axes:
+                local_n *= x.shape[ax]
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            n = local_n
+            if self.axis_name is not None:
+                # Cross-replica sync: one fused pmean for (mean, E[x^2]).
+                mean, mean_sq = jax.lax.pmean((mean, mean_sq), self.axis_name)
+                n = local_n * jax.lax.psum(1, self.axis_name)
+            var = mean_sq - jnp.square(mean)  # biased: used for normalization
+
+            if not self.is_initializing() and self.is_mutable_collection("batch_stats"):
+                unbiased = var * (n / max(n - 1, 1))
+                m = self.momentum
+                ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
+                ra_var.value = (1.0 - m) * ra_var.value + m * unbiased
+
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        y = (xf - mean) * inv * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        out_dtype = self.dtype or x.dtype
+        return y.astype(out_dtype)

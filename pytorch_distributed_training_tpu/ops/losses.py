@@ -1,0 +1,26 @@
+"""Loss functions (jit-safe, TPU-friendly).
+
+Replaces ``torch.nn.CrossEntropyLoss()`` as used by the reference
+(train_distributed.py:202, :275, :313): integer class targets, mean reduction
+over the batch.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["cross_entropy_loss"]
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels.
+
+    Matches ``torch.nn.CrossEntropyLoss`` defaults (mean reduction, no label
+    smoothing).  Computed in float32 regardless of the (possibly bf16) logits
+    dtype — the reference's AMP-era convention, and numerically required for
+    a stable logsumexp on TPU.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return jnp.mean(logz - true_logit)

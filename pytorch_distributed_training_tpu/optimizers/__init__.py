@@ -1,0 +1,171 @@
+"""Optimizer factories with PyTorch-exact update semantics.
+
+Re-provides the ``dl_lib.optimizers`` surface pinned by the reference at
+train_distributed.py:30, :204-207: ``get_optimizer(cfg) -> class``, then
+instantiated with the config minus its ``name`` key.  Names: ``SGD`` (used by
+both reference configs) plus ``LARS`` for the large-batch pod recipe.
+
+Accuracy parity lives or dies on update-rule fidelity (SURVEY.md §7 "hard
+parts" #1), so ``SGD`` replicates ``torch.optim.SGD`` exactly:
+
+  - **coupled** weight decay: ``d = g + wd * p`` folded into the gradient
+    *before* the momentum update (NOT optax's decoupled
+    ``add_decayed_weights``-after-momentum),
+  - PyTorch momentum: ``buf = mu * buf + (1 - dampening) * d`` with the
+    first-step special case ``buf = d`` (torch initializes the buffer to the
+    first update, not to zero),
+  - update ``p <- p - lr * (d + mu * buf)`` if nesterov else ``p - lr * buf``.
+
+Design: optimizers are functional — ``init(params) -> state`` and
+``update(grads, state, params, lr) -> (new_params, new_state)`` — and are
+called *inside* the compiled train step, so the parameter update fuses into
+the same XLA program as forward/backward/psum (the reference's separate
+``optimizer.step()`` kernel launches, train_distributed.py:277, have no
+analog: XLA fuses them away).  ``lr`` is passed per-call because the schedule
+is evaluated on-device from the step counter (see ``schedulers``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SGD", "LARS", "get_optimizer", "OPTIMIZERS", "SGDState"]
+
+
+class SGDState(NamedTuple):
+    momentum: Any  # pytree like params (zeros when momentum == 0)
+    step: jnp.ndarray  # scalar int32, number of updates applied so far
+
+
+class SGD:
+    """``torch.optim.SGD``-semantics SGD (see module docstring)."""
+
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.0,
+        weight_decay: float = 0.0,
+        dampening: float = 0.0,
+        nesterov: bool = False,
+    ):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires momentum > 0 and dampening = 0")
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.weight_decay = float(weight_decay)
+        self.dampening = float(dampening)
+        self.nesterov = bool(nesterov)
+
+    def init(self, params) -> SGDState:
+        return SGDState(
+            momentum=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def update(self, grads, state: SGDState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        mu, wd, damp = self.momentum, self.weight_decay, self.dampening
+        first = state.step == 0
+
+        def one(g, p, buf):
+            d = g + wd * p if wd != 0 else g
+            if mu != 0:
+                # torch: buffer starts as the first d, not as mu*0 + (1-damp)*d.
+                new_buf = jnp.where(first, d, mu * buf + (1.0 - damp) * d)
+                step_dir = d + mu * new_buf if self.nesterov else new_buf
+            else:
+                new_buf = buf
+                step_dir = d
+            return p - lr * step_dir, new_buf
+
+        flat = jax.tree.map(one, grads, params, state.momentum)
+        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
+
+
+def _is_excluded(path) -> bool:
+    """True for params LARS should not adapt: biases + norm scales/offsets.
+
+    Matches the standard large-batch recipe (LARS paper / MLPerf ResNet): BN
+    parameters and biases get neither weight decay nor the trust-ratio
+    scaling.  Detection is by parameter-tree path: our BatchNorm params live
+    under a ``*bn*`` module scope and are named ``scale`` / ``bias``.
+    """
+    keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    last = keys[-1] if keys else ""
+    if last == "bias":
+        return True
+    return any("bn" in str(k).lower() or "batchnorm" in str(k).lower() for k in keys)
+
+
+class LARS:
+    """Layer-wise Adaptive Rate Scaling (You et al., 2017) with momentum.
+
+    For each non-excluded param: trust = eta * ||p|| / (||g|| + wd * ||p||),
+    then PyTorch-style momentum on ``trust * (g + wd * p)``.  Excluded params
+    (biases, norm scale/offset) fall back to plain momentum SGD without WD.
+    Used by the large-batch (8k, LARS) pod config from BASELINE.json.
+    """
+
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.9,
+        weight_decay: float = 0.0,
+        eta: float = 0.001,
+        eps: float = 1e-9,
+    ):
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.weight_decay = float(weight_decay)
+        self.eta = float(eta)
+        self.eps = float(eps)
+
+    def init(self, params) -> SGDState:
+        return SGDState(
+            momentum=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def update(self, grads, state: SGDState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        mu, wd, eta, eps = self.momentum, self.weight_decay, self.eta, self.eps
+
+        def one(path, g, p, buf):
+            if _is_excluded(path):
+                d = g
+            else:
+                p_norm = jnp.linalg.norm(p.reshape(-1))
+                g_norm = jnp.linalg.norm(g.reshape(-1))
+                trust = jnp.where(
+                    (p_norm > 0) & (g_norm > 0),
+                    eta * p_norm / (g_norm + wd * p_norm + eps),
+                    1.0,
+                )
+                d = trust * (g + wd * p)
+            new_buf = mu * buf + d
+            return p - lr * new_buf, new_buf
+
+        flat = jax.tree_util.tree_map_with_path(one, grads, params, state.momentum)
+        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
+
+
+OPTIMIZERS = {
+    "SGD": SGD,
+    "LARS": LARS,
+}
+
+
+def get_optimizer(cfg: Dict[str, Any]):
+    """Return the optimizer *class* for ``cfg['name']`` (reference: :204)."""
+    name = cfg["name"]
+    if name not in OPTIMIZERS:
+        raise KeyError(f"unknown optimizer '{name}' (have: {sorted(OPTIMIZERS)})")
+    return OPTIMIZERS[name]

@@ -1,0 +1,30 @@
+"""Device mesh + multi-host bootstrap + sharding helpers.
+
+The TPU-native replacement for the reference's distributed layer
+(``torch.distributed`` + NCCL + TCPStore, train_distributed.py:149-154;
+SURVEY.md §2.3, §5.8): process-group init becomes
+``jax.distributed.initialize`` over DCN (coordinator = the reference's
+``--dist-url``); NCCL collectives become XLA collectives over ICI emitted by
+the compiled program; DDP/SyncBN wrappers disappear into in-graph
+``psum``/``pmean``.
+"""
+from .distributed import initialize_distributed, parse_dist_url
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_pspec,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+
+__all__ = [
+    "initialize_distributed",
+    "parse_dist_url",
+    "make_mesh",
+    "batch_sharding",
+    "batch_pspec",
+    "replicated_sharding",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+]

@@ -1,0 +1,69 @@
+"""Device mesh construction + sharding helpers.
+
+Design stance (SURVEY.md §7): a 2-D ``(data, model)`` mesh with the model
+axis trivial (size 1) for the reference's pure-DP workload — DP is the only
+strategy the reference implements (SURVEY.md §2.4) but the mesh deliberately
+keeps a model axis open so tensor/pipeline sharding can land without
+reshaping the core (§2.4 "mesh design should leave a model axis open").
+``mesh_utils.create_device_mesh`` orders devices so the data axis rides ICI
+within a slice.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "batch_sharding",
+    "batch_pspec",
+    "replicated_sharding",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(devices: Optional[Sequence] = None, model_parallelism: int = 1) -> Mesh:
+    """Build the global ``(data, model)`` mesh over all addressable processes.
+
+    Args:
+      devices: explicit device list (default: all of ``jax.devices()``, which
+        spans every host after ``jax.distributed.initialize``).
+      model_parallelism: size of the model axis (1 = pure DP, the reference's
+        only strategy).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % model_parallelism != 0:
+        raise ValueError(
+            f"{n} devices not divisible by model_parallelism={model_parallelism}"
+        )
+    shape = (n // model_parallelism, model_parallelism)
+    if len(devices) == jax.device_count() and devices == jax.devices():
+        dev_array = mesh_utils.create_device_mesh(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_pspec(ndim: int) -> P:
+    """PartitionSpec sharding the leading (batch) dim over the data axis."""
+    return P(DATA_AXIS, *([None] * (ndim - 1)))
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """NamedSharding for an ``[batch, ...]`` array (NHWC images: ndim=4)."""
+    return NamedSharding(mesh, batch_pspec(ndim))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated sharding (params / optimizer state in pure DP)."""
+    return NamedSharding(mesh, P())

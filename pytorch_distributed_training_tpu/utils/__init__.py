@@ -1,0 +1,70 @@
+"""Determinism + iteration helpers.
+
+Re-provides the ``dl_lib.utils`` surface pinned by the reference at
+train_distributed.py:27 (``make_deterministic``, ``make_iter_dataloader``),
+re-designed for a JAX runtime: JAX PRNG keys are explicit, so
+``make_deterministic`` seeds the *host* RNGs (python/numpy/torch-if-present)
+and records a global base seed from which the framework derives
+``jax.random.PRNGKey`` streams.
+"""
+from __future__ import annotations
+
+import random
+from typing import Generator, Iterable, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "make_deterministic",
+    "get_base_seed",
+    "make_iter_dataloader",
+]
+
+_BASE_SEED: Optional[int] = None
+
+
+def make_deterministic(seed: int) -> None:
+    """Seed all host-side RNGs and record the framework base seed.
+
+    Reference contract (train_distributed.py:51-53, :141-142): called once in
+    the parent and once per worker with the *same* seed on all ranks, so model
+    init is identical everywhere (which is what makes DDP's initial param
+    broadcast redundant — we rely on the same property: replicated same-seed
+    init instead of a broadcast collective).
+
+    On TPU/XLA, kernel determinism is the default; there is no
+    ``cudnn.deterministic`` analog to set.
+    """
+    global _BASE_SEED
+    _BASE_SEED = int(seed)
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    try:  # torch is an optional host-side dependency (parity tests only)
+        import torch
+
+        torch.manual_seed(seed)
+    except ImportError:  # pragma: no cover
+        pass
+
+
+def get_base_seed(default: int = 0) -> int:
+    """Base seed recorded by :func:`make_deterministic` (``default`` if unset)."""
+    return _BASE_SEED if _BASE_SEED is not None else default
+
+
+def make_iter_dataloader(loader: Iterable) -> Generator[Tuple, None, None]:
+    """Convert an epoch-based loader into an infinite per-iteration generator.
+
+    Reference contract (train_distributed.py:27, :249-252): the training loop
+    is iteration-based (``train_iters`` total) and draws ``(img, label)``
+    batches forever.  Between epochs we advance the loader's epoch so the
+    distributed shuffle re-randomizes (the analog of
+    ``DistributedSampler.set_epoch``).
+    """
+    epoch = 0
+    while True:
+        if hasattr(loader, "set_epoch"):
+            loader.set_epoch(epoch)
+        for batch in loader:
+            yield batch
+        epoch += 1

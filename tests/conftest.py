@@ -1,0 +1,28 @@
+"""Test harness: run the real pjit/shard_map path on 8 virtual CPU devices.
+
+The TPU analog of a fake distributed backend (SURVEY.md §4): JAX compiles and
+executes the same SPMD program on N host-platform devices, so collectives,
+sharding, and SyncBN semantics are exercised without a pod.  Must run before
+any ``import jax`` in the test session.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# Make the repo root importable regardless of pytest invocation directory.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# A site-installed accelerator plugin may have already forced
+# jax_platforms to itself (overriding the env var); pin tests to CPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+

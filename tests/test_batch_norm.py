@@ -1,0 +1,124 @@
+"""DistributedBatchNorm parity vs torch.nn.BatchNorm2d + SyncBN semantics.
+
+The sync test is the SURVEY.md §4 prescription: global-batch stats on N fake
+devices must equal single-device full-batch stats.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from pytorch_distributed_training_tpu.ops import DistributedBatchNorm
+
+
+def _torch_bn_step(x_nchw, training=True, steps=1):
+    bn = torch.nn.BatchNorm2d(x_nchw.shape[1], eps=1e-5, momentum=0.1)
+    bn.train(training)
+    with torch.no_grad():
+        for _ in range(steps):
+            out = bn(torch.tensor(x_nchw))
+    return (
+        out.numpy(),
+        bn.running_mean.numpy(),
+        bn.running_var.numpy(),
+    )
+
+
+def test_train_mode_matches_torch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 5, 6, 3)).astype(np.float32)  # NHWC
+    x_nchw = np.transpose(x, (0, 3, 1, 2))
+
+    ref_out, ref_mean, ref_var = _torch_bn_step(x_nchw, training=True, steps=1)
+
+    bn = DistributedBatchNorm(use_running_average=False)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out, updated = bn.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+
+    np.testing.assert_allclose(
+        np.transpose(np.asarray(out), (0, 3, 1, 2)), ref_out, rtol=1e-4, atol=1e-5
+    )
+    # Running stats: torch uses UNBIASED batch var for the running update.
+    np.testing.assert_allclose(
+        np.asarray(updated["batch_stats"]["mean"]), ref_mean, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(updated["batch_stats"]["var"]), ref_var, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_eval_mode_uses_running_stats():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 3, 3, 2)).astype(np.float32)
+    bn = DistributedBatchNorm(use_running_average=True)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    out = bn.apply(variables, jnp.asarray(x))
+    # fresh running stats are mean 0 var 1 -> output ~= input (eps only)
+    np.testing.assert_allclose(np.asarray(out), x / np.sqrt(1 + 1e-5), rtol=1e-5)
+
+
+def test_sync_bn_equals_full_batch():
+    """N-device synced stats == 1-device full-batch stats (SyncBatchNorm parity)."""
+    n_dev = jax.device_count()
+    assert n_dev >= 4, "conftest must provide 8 virtual devices"
+    rng = np.random.default_rng(2)
+    full = rng.normal(size=(16, 4, 4, 3)).astype(np.float32)
+
+    # Single-device full-batch reference.
+    bn_local = DistributedBatchNorm(use_running_average=False)
+    variables = bn_local.init(jax.random.PRNGKey(0), jnp.asarray(full))
+    ref_out, ref_updated = bn_local.apply(
+        variables, jnp.asarray(full), mutable=["batch_stats"]
+    )
+
+    # Sharded: per-device shard of the batch, axis_name sync.
+    bn_sync = DistributedBatchNorm(use_running_average=False, axis_name="data")
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(jax.sharding.PartitionSpec(), jax.sharding.PartitionSpec("data")),
+        out_specs=(jax.sharding.PartitionSpec("data"), jax.sharding.PartitionSpec()),
+    )
+    def sharded_apply(variables, x):
+        out, updated = bn_sync.apply(variables, x, mutable=["batch_stats"])
+        return out, updated["batch_stats"]
+
+    out, stats = sharded_apply(variables, jnp.asarray(full))
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats["mean"]),
+        np.asarray(ref_updated["batch_stats"]["mean"]),
+        rtol=1e-5, atol=1e-6,
+    )
+    # Note: sync running-var uses the GLOBAL element count for the unbiased
+    # correction (like torch SyncBatchNorm), so it matches full-batch exactly.
+    np.testing.assert_allclose(
+        np.asarray(stats["var"]),
+        np.asarray(ref_updated["batch_stats"]["var"]),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_momentum_accumulation_matches_torch():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, 5, 6, 3)).astype(np.float32)
+    x_nchw = np.transpose(x, (0, 3, 1, 2))
+    _, ref_mean, ref_var = _torch_bn_step(x_nchw, training=True, steps=3)
+
+    bn = DistributedBatchNorm(use_running_average=False)
+    variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x))
+    for _ in range(3):
+        _, updated = bn.apply(variables, jnp.asarray(x), mutable=["batch_stats"])
+        variables = {"params": variables["params"], **updated}
+
+    np.testing.assert_allclose(
+        np.asarray(variables["batch_stats"]["mean"]), ref_mean, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(variables["batch_stats"]["var"]), ref_var, rtol=1e-5, atol=1e-6
+    )

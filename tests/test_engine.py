@@ -1,0 +1,167 @@
+"""Engine: compiled SPMD train/eval steps on the 8-device mesh + full Runner.
+
+This is the "minimum end-to-end slice" oracle (SURVEY.md §7 stage 3): the
+test-sync config semantics with a synthetic dataset, real pjit/shard_map
+collectives on fake devices.
+"""
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.engine import (
+    Runner,
+    build_eval_step,
+    build_train_step,
+    init_train_state,
+)
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.parallel import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+
+def _tiny_setup(sync_bn: bool, n_classes: int = 8):
+    mesh = make_mesh()
+    model = get_model(
+        "ResNet18", num_classes=n_classes, axis_name=DATA_AXIS if sync_bn else None
+    )
+    opt = SGD(lr=0.001, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.001, [1000], 0.1)
+    state = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    train_step = build_train_step(model, opt, lr_fn, mesh, sync_bn=sync_bn)
+    eval_step = build_eval_step(model, mesh)
+    return mesh, state, train_step, eval_step
+
+
+def _batch(mesh, rng, batch=64, n_classes=8):
+    img = rng.standard_normal((batch, 32, 32, 3)).astype(np.float32)
+    label = (rng.integers(0, n_classes, (batch,))).astype(np.int32)
+    # class-dependent signal so a few steps of training measurably help
+    img += 0.5 * label[:, None, None, None] / n_classes
+    g_img = jax.device_put(img, batch_sharding(mesh, 4))
+    g_label = jax.device_put(label, batch_sharding(mesh, 1))
+    return g_img, g_label
+
+
+@pytest.mark.parametrize("sync_bn", [True, False])
+def test_train_step_decreases_loss(sync_bn):
+    mesh, state, train_step, _ = _tiny_setup(sync_bn)
+    rng = np.random.default_rng(0)
+    img, label = _batch(mesh, rng)
+    losses = []
+    for _ in range(12):
+        state, loss = train_step(state, img, label)
+        losses.append(float(loss))
+    assert int(state.step) == 12
+    assert min(losses[-3:]) < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_train_state_stays_replicated():
+    mesh, state, train_step, _ = _tiny_setup(sync_bn=True)
+    rng = np.random.default_rng(1)
+    img, label = _batch(mesh, rng)
+    state, _ = train_step(state, img, label)
+    # params remain fully-replicated across the mesh after the update
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.sharding.is_fully_replicated
+    bs_leaf = jax.tree.leaves(state.batch_stats)[0]
+    assert bs_leaf.sharding.is_fully_replicated
+
+
+def test_sync_bn_stats_update_in_train_step():
+    mesh, state, train_step, _ = _tiny_setup(sync_bn=True)
+    before = jax.tree.map(np.asarray, state.batch_stats)
+    rng = np.random.default_rng(2)
+    img, label = _batch(mesh, rng)
+    state, _ = train_step(state, img, label)
+    after = jax.tree.map(np.asarray, state.batch_stats)
+    changed = jax.tree.map(lambda a, b: not np.allclose(a, b), before, after)
+    assert any(jax.tree.leaves(changed))
+
+
+def test_eval_step_metrics_sane():
+    mesh, state, train_step, eval_step = _tiny_setup(sync_bn=True)
+    rng = np.random.default_rng(3)
+    img, label = _batch(mesh, rng)
+    loss, acc1, acc5 = eval_step(state, img, label)
+    assert np.isfinite(float(loss))
+    assert 0.0 <= float(acc1) <= 100.0
+    assert float(acc5) >= float(acc1)
+
+
+def _tiny_cfg(tmp_path):
+    return {
+        "dataset": {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 128,
+        },
+        "training": {
+            "optimizer": {"name": "SGD", "lr": 0.05, "weight_decay": 1.0e-4, "momentum": 0.9},
+            "lr_schedule": {"name": "multi_step", "milestones": [4], "gamma": 0.1},
+            "train_iters": 6,
+            "print_interval": 2,
+            "val_interval": 3,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+
+
+def test_runner_end_to_end(tmp_path):
+    """The reference flow end-to-end: Runner -> worker -> train loop -> val.
+
+    Mirrors cold-start call stack SURVEY.md §3.1 on the 8-device CPU mesh.
+    """
+
+    class _FakeTB:
+        def __init__(self):
+            self.scalars = []
+
+        def add_scalar(self, tag, value, step):
+            self.scalars.append((tag, value, step))
+
+    tb = _FakeTB()
+    runner = Runner(
+        num_nodes=1,
+        rank=0,
+        seed=1029,
+        dist_url="tcp://127.0.0.1:9901",
+        dist_backend="tpu",
+        multiprocessing=True,
+        logger_queue=None,
+        global_cfg=_tiny_cfg(tmp_path),
+        tb_writer_constructor=lambda: tb,
+    )
+    runner()
+
+    assert runner.iter == 6
+    tags = {t for t, _, _ in tb.scalars}
+    # the reference's exact five tag families (train_distributed.py:295-297, :329-331)
+    assert {"loss/train", "lr_group/0", "eval/Acc@1", "eval/Acc@5", "eval/loss"} <= tags
+    # val ran at iters 2 and 5 (is_val semantics :255-259)
+    val_iters = sorted(s for t, _, s in tb.scalars if t == "eval/Acc@1")
+    assert val_iters == [2, 5]
+    train_losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert all(np.isfinite(v) for v in train_losses)
+    # world: all 8 fake devices participate
+    assert runner.world_size == 8
+    assert runner.global_batch == 16

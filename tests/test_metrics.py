@@ -1,0 +1,56 @@
+"""accuracy + AverageMeter parity (reference: train_distributed.py:305-321)."""
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.metrics import AverageMeter, accuracy
+
+
+def test_accuracy_topk():
+    # 4 samples, 6 classes; construct known top-1/top-5 membership.
+    logits = jnp.array(
+        [
+            [9.0, 1, 2, 3, 4, 5],  # top1=0
+            [0.0, 9, 2, 3, 4, 5],  # top1=1
+            [5.0, 4, 3, 2, 1, 0],  # top1=0
+            [0.0, 1, 2, 3, 4, 9],  # top1=5
+        ]
+    )
+    labels = jnp.array([0, 1, 5, 0])  # hits: yes, yes, no(top5? 5 ranks 6th? see below), no
+    acc1, acc5 = accuracy(logits, labels, topk=(1, 5))
+    # top-1: samples 0,1 correct -> 50%
+    assert np.isclose(float(acc1), 50.0)
+    # top-5 of sample 2: classes [0,1,2,3,4] -> label 5 NOT in top-5.
+    # top-5 of sample 3: classes [5,4,3,2,1] -> label 0 NOT in top-5.
+    assert np.isclose(float(acc5), 50.0)
+
+
+def test_accuracy_matches_torch_reference_impl():
+    """Cross-check against the classic pytorch-examples accuracy()."""
+    import torch
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(64, 100)).astype(np.float32)
+    labels = rng.integers(0, 100, size=(64,))
+
+    t_logits, t_labels = torch.tensor(logits), torch.tensor(labels)
+    maxk = 5
+    _, pred = t_logits.topk(maxk, 1, True, True)
+    correct = pred.t().eq(t_labels.view(1, -1).expand_as(pred.t()))
+    ref1 = correct[:1].reshape(-1).float().sum(0) * 100.0 / 64
+    ref5 = correct[:5].reshape(-1).float().sum(0) * 100.0 / 64
+
+    acc1, acc5 = accuracy(jnp.asarray(logits), jnp.asarray(labels), topk=(1, 5))
+    assert np.isclose(float(acc1), float(ref1))
+    assert np.isclose(float(acc5), float(ref5))
+
+
+def test_average_meter_unweighted():
+    m = AverageMeter()
+    assert m.value() == 0.0
+    m.update(1.0)
+    m.update(3.0)
+    assert m.value() == 2.0  # unweighted mean over updates
+    m.reset()
+    m.update(5.0, n=4)
+    m.update(1.0)
+    assert np.isclose(m.value(), 21.0 / 5)

@@ -1,0 +1,101 @@
+"""Optimizer parity: our SGD must bit-match torch.optim.SGD semantics.
+
+Coupled weight decay (folded into grad BEFORE momentum), torch momentum with
+first-step buffer init, nesterov — SURVEY.md §7 hard part #1.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from pytorch_distributed_training_tpu.optimizers import LARS, SGD, get_optimizer
+
+
+def _run_parity(momentum, weight_decay, nesterov, dampening=0.0, steps=6):
+    rng = np.random.default_rng(42)
+    shapes = [(4, 3), (7,), (2, 2, 3)]
+    params_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+    grads_np = [
+        [rng.normal(size=s).astype(np.float32) for s in shapes] for _ in range(steps)
+    ]
+
+    # torch side
+    t_params = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
+    t_opt = torch.optim.SGD(
+        t_params,
+        lr=0.1,
+        momentum=momentum,
+        weight_decay=weight_decay,
+        nesterov=nesterov,
+        dampening=dampening,
+    )
+    for step_grads in grads_np:
+        for p, g in zip(t_params, step_grads):
+            p.grad = torch.tensor(g)
+        t_opt.step()
+
+    # our side
+    opt = SGD(lr=0.1, momentum=momentum, weight_decay=weight_decay,
+              nesterov=nesterov, dampening=dampening)
+    params = [jnp.asarray(p) for p in params_np]
+    state = opt.init(params)
+    for step_grads in grads_np:
+        params, state = opt.update([jnp.asarray(g) for g in step_grads], state, params)
+
+    for ours, theirs in zip(params, t_params):
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.detach().numpy(), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_sgd_plain():
+    _run_parity(momentum=0.0, weight_decay=0.0, nesterov=False)
+
+
+def test_sgd_momentum_wd():
+    """The reference recipe: lr 0.1, momentum 0.9, wd 1e-4 (config/ResNet50.yml:7-11)."""
+    _run_parity(momentum=0.9, weight_decay=1e-4, nesterov=False)
+
+
+def test_sgd_nesterov():
+    _run_parity(momentum=0.9, weight_decay=1e-4, nesterov=True)
+
+
+def test_sgd_dampening():
+    """First-step buffer init differs from mu*0 + (1-damp)*d — must match torch."""
+    _run_parity(momentum=0.9, weight_decay=1e-4, nesterov=False, dampening=0.3)
+
+
+def test_sgd_jit_compatible():
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, grads, lr):
+        return opt.update(grads, state, params, lr)
+
+    grads = {"w": jnp.full((3, 3), 0.5), "b": jnp.full((3,), 0.1)}
+    params, state = step(params, state, grads, jnp.float32(0.1))
+    assert int(state.step) == 1
+    assert float(params["w"][0, 0]) < 1.0
+
+
+def test_factory():
+    assert get_optimizer({"name": "SGD"}) is SGD
+    assert get_optimizer({"name": "LARS"}) is LARS
+    with pytest.raises(KeyError):
+        get_optimizer({"name": "Adam"})
+
+
+def test_lars_smoke():
+    opt = LARS(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    params = {"conv": {"kernel": jnp.ones((3, 3))}, "fc": {"bias": jnp.ones((3,))}}
+    state = opt.init(params)
+    grads = jax.tree.map(lambda p: 0.1 * jnp.ones_like(p), params)
+    new_params, state = opt.update(grads, state, params)
+    # all params moved, none NaN
+    for leaf in jax.tree.leaves(new_params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+        assert not np.allclose(np.asarray(leaf), 1.0)

@@ -1,0 +1,51 @@
+"""Scheduler parity: per-iteration MultiStepLR vs torch, + warmup shape.
+
+The reference steps the scheduler every iteration (train_distributed.py:299),
+so milestones are iteration counts (SURVEY.md §7 hard part #1).
+"""
+import numpy as np
+
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.schedulers import get_scheduler, multi_step_lr
+
+
+def test_multi_step_matches_torch():
+    import torch
+
+    base_lr, milestones, gamma = 0.1, [5, 9], 0.1
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=base_lr)
+    sched = torch.optim.lr_scheduler.MultiStepLR(opt, milestones=milestones, gamma=gamma)
+
+    ours = multi_step_lr(base_lr, milestones, gamma)
+    for i in range(15):
+        torch_lr = sched.get_last_lr()[0]  # lr used at iteration i
+        assert np.isclose(float(ours(i)), torch_lr), f"iter {i}"
+        opt.step()
+        sched.step()
+
+
+def test_scheduler_object_surface():
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    sched = get_scheduler(opt, {"name": "multi_step", "milestones": [2, 4], "gamma": 0.1})
+    lrs = []
+    for _ in range(6):
+        lrs.append(sched.get_last_lr()[0])  # lr for current iter (:285)
+        sched.step()  # per-iteration step (:299)
+    assert np.allclose(lrs, [0.1, 0.1, 0.01, 0.01, 0.001, 0.001])
+
+
+def test_linear_warmup():
+    fn = multi_step_lr(0.1, [100], 0.1, warmup_iters=10, warmup_mode="linear", warmup_factor=0.5)
+    # At step 0: factor = 0.5 -> lr 0.05; ramps to 0.1 by step 10.
+    assert np.isclose(float(fn(0)), 0.05)
+    assert np.isclose(float(fn(5)), 0.1 * (0.5 * 0.5 + 0.5))
+    assert np.isclose(float(fn(10)), 0.1)
+    assert np.isclose(float(fn(150)), 0.01)  # post-milestone decay still applies
+
+
+def test_constant_warmup():
+    fn = multi_step_lr(1.0, [], 0.1, warmup_iters=4, warmup_mode="constant", warmup_factor=0.25)
+    assert np.isclose(float(fn(0)), 0.25)
+    assert np.isclose(float(fn(3)), 0.25)
+    assert np.isclose(float(fn(4)), 1.0)

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Launcher — reference-compatible surface (reference: train.sh:1-14).
+# One controller process per TPU host; on a multi-host pod run this script on
+# every host with RANK=<host index> and the shared coordinator --dist-url.
+export PYTHONPATH=./:${PYTHONPATH}
+
+python train_distributed.py \
+    --num-nodes 1 \
+    --rank 0 \
+    --multiprocessing \
+    --dist-backend tpu \
+    --dist-url tcp://localhost:9001 \
+    --log-dir run/distributed-with-syncbn \
+    --file-name-cfg ResNet50 \
+    --cfg-filepath config/ResNet50.yml \
+    --seed 1029 &
