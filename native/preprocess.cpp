@@ -1,0 +1,73 @@
+// Native host-side input-pipeline kernels.
+//
+// The reference gets its host data path from PyTorch natives: DataLoader
+// worker processes + pinned-memory staging (train_distributed.py:227-241,
+// SURVEY.md §2.3).  The TPU rebuild keeps decode in PIL (already C) and
+// owns the *batch assembly* hot path natively: a fused
+// uint8 -> float32, /255, -mean, /std normalization over the whole NHWC
+// batch, parallelized across a thread pool.  In pure numpy this is 3-4
+// full-batch temporaries; here it is one streaming pass per thread.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image).
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// in:  [n, h*w, 3] uint8 pixels (contiguous NHWC)
+// out: [n, h*w, 3] float32, out = in * scale[c] + bias[c]
+//   where scale[c] = 1/(255*std[c]), bias[c] = -mean[c]/std[c]
+// n_threads <= 0 selects hardware_concurrency.
+void pdt_normalize_u8_nhwc(
+    const uint8_t* in,
+    float* out,
+    long n_images,
+    long pixels_per_image,  // h*w
+    const float* scale,     // [3]
+    const float* bias,      // [3]
+    int n_threads) {
+  if (n_threads <= 0) {
+    // Cap the default: this pass is memory-bound and shares the host with
+    // the loader's decode threads — spawning hardware_concurrency threads
+    // per batch oversubscribes and pays create/join overhead for nothing.
+    n_threads = static_cast<int>(
+        std::min(8u, std::max(1u, std::thread::hardware_concurrency())));
+  }
+  n_threads = static_cast<int>(
+      std::min<long>(n_threads, std::max<long>(n_images, 1)));
+
+  const float s0 = scale[0], s1 = scale[1], s2 = scale[2];
+  const float b0 = bias[0], b1 = bias[1], b2 = bias[2];
+  const long stride = pixels_per_image * 3;
+
+  auto work = [&](long img_begin, long img_end) {
+    for (long i = img_begin; i < img_end; ++i) {
+      const uint8_t* src = in + i * stride;
+      float* dst = out + i * stride;
+      for (long p = 0; p < pixels_per_image; ++p) {
+        dst[3 * p + 0] = src[3 * p + 0] * s0 + b0;
+        dst[3 * p + 1] = src[3 * p + 1] * s1 + b1;
+        dst[3 * p + 2] = src[3 * p + 2] * s2 + b2;
+      }
+    }
+  };
+
+  if (n_threads == 1) {
+    work(0, n_images);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  const long chunk = (n_images + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const long begin = t * chunk;
+    const long end = std::min<long>(begin + chunk, n_images);
+    if (begin >= end) break;
+    threads.emplace_back(work, begin, end);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

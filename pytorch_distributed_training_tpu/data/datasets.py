@@ -115,6 +115,11 @@ class ImageFolderDataset:
     def __len__(self) -> int:
         return len(self.samples)
 
+    # Per-channel normalization applied at batch-assembly time by the
+    # loader's fused native kernel (see data/loader.py + native/).
+    norm_mean = IMAGENET_MEAN
+    norm_std = IMAGENET_STD
+
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
         from PIL import Image
 
@@ -127,8 +132,9 @@ class ImageFolderDataset:
                     im = im.transpose(Image.FLIP_LEFT_RIGHT)
             else:
                 im = _resize_center_crop(im, self.image_size)
-            arr = np.asarray(im, dtype=np.float32) / 255.0
-        arr = (arr - IMAGENET_MEAN) / IMAGENET_STD
+            # uint8 here; the /255-mean/std normalization is fused into the
+            # native batch-assembly pass (one pass, no per-image temporaries)
+            arr = np.asarray(im, dtype=np.uint8)
         return arr, np.int64(label)
 
 

@@ -55,6 +55,12 @@ class DataLoader:
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
 
+    def skip_next(self, n_batches: int) -> None:
+        """Skip the first ``n_batches`` of the NEXT iteration only — an
+        index-level fast-forward (no decode cost) used by checkpoint resume
+        to re-align the data stream with the restored iteration counter."""
+        self._skip_next = int(n_batches)
+
     def _batch_indices(self) -> list:
         idx = self.sampler.local_indices()
         n = len(idx)
@@ -79,11 +85,27 @@ class DataLoader:
         else:
             samples = [self.dataset[i] for i in indices]
         imgs = np.stack([s[0] for s in samples])
+        if imgs.dtype == np.uint8:
+            # fused uint8 -> normalized float32 (native C++ kernel, threaded;
+            # numpy fallback inside) — the pinned-memory/worker-pool stage of
+            # the reference's DataLoader, done once per batch
+            from ..native import normalize_batch
+
+            mean = getattr(self.dataset, "norm_mean", None)
+            std = getattr(self.dataset, "norm_std", None)
+            if mean is not None and std is not None:
+                imgs = normalize_batch(imgs, mean, std)
+            else:
+                imgs = imgs.astype(np.float32) / 255.0
         labels = np.asarray([s[1] for s in samples], dtype=np.int64)
         return imgs, labels
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         batches = self._batch_indices()
+        skip = getattr(self, "_skip_next", 0)
+        if skip:
+            batches = batches[skip:]
+            self._skip_next = 0
         if not batches:
             return
         pool = ThreadPoolExecutor(self.num_workers) if self.num_workers > 0 else None

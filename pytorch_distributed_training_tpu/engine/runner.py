@@ -58,6 +58,7 @@ from ..parallel import (
 )
 from ..schedulers import get_scheduler
 from ..utils import make_deterministic, make_iter_dataloader
+from .checkpoint import Checkpointer
 from .steps import build_eval_step, build_train_step, init_train_state
 
 __all__ = ["Runner"]
@@ -255,7 +256,25 @@ class Runner:
         self._tput_t0 = time.monotonic()
         self._tput_iters = 0
 
-        iter_generator = make_iter_dataloader(train_loader)
+        # --- optional checkpoint/resume (absent in reference; config-gated) --
+        self.checkpointer = Checkpointer.from_config(train_cfg)
+        if self.checkpointer:
+            if train_cfg["checkpoint"].get("resume", True):
+                self.state, start_iter = self.checkpointer.restore_latest(
+                    self.state, self.logger
+                )
+                self.iter = start_iter
+                self.scheduler.last_epoch = start_iter
+            elif self.checkpointer.latest() is not None:
+                # orbax never overwrites an existing step; starting a fresh
+                # run into a populated dir would crash at the first save
+                raise ValueError(
+                    f"checkpoint dir {self.checkpointer.directory} already has "
+                    f"step {self.checkpointer.latest()} but resume is False — "
+                    "clear the directory or point checkpoint.dir elsewhere"
+                )
+
+        iter_generator = make_iter_dataloader(train_loader, start_iter=self.iter)
 
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
@@ -270,7 +289,14 @@ class Runner:
 
             if is_val():
                 self.validate()
+            if self.checkpointer and self.checkpointer.should_save(
+                self.iter, train_cfg["train_iters"]
+            ):
+                self.checkpointer.save(self.iter, self.state)
             self.iter += 1
+        if self.checkpointer:
+            self.checkpointer.wait()
+            self.checkpointer.close()
 
     # ------------------------------------------------------------- hot loop
     def _put_batch(self, img: np.ndarray, label: np.ndarray):

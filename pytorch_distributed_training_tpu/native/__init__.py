@@ -1,0 +1,112 @@
+"""ctypes bindings for the native host-pipeline library.
+
+``normalize_batch`` is the fused uint8->normalized-float32 batch-assembly
+kernel (see native/preprocess.cpp for why it's native).  The library is
+auto-built from source on first use when a C++ toolchain is present; without
+one, a numpy fallback keeps the framework fully functional (same results,
+more temporaries).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["normalize_batch", "native_available", "ensure_built"]
+
+_LIB_NAME = "libpdt_native.so"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, _LIB_NAME)
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def ensure_built() -> bool:
+    """Build (if needed) and load the native library; returns availability."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return True
+    if _build_failed:
+        return False
+    with _lock:
+        if _lib is not None:
+            return True
+        if _build_failed:
+            return False
+        try:
+            # Always invoke make: its dependency check rebuilds when the
+            # source is newer than the .so (a mere existence check would run
+            # stale kernels after source edits).
+            if os.path.isdir(_SRC_DIR):
+                subprocess.run(
+                    ["make", "-s"],
+                    cwd=_SRC_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.pdt_normalize_u8_nhwc.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long,
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int,
+            ]
+            lib.pdt_normalize_u8_nhwc.restype = None
+            _lib = lib
+            return True
+        except Exception:
+            _build_failed = True
+            return False
+
+
+def native_available() -> bool:
+    return ensure_built()
+
+
+def normalize_batch(
+    batch_u8: np.ndarray,
+    mean: np.ndarray,
+    std: np.ndarray,
+    n_threads: int = 0,
+) -> np.ndarray:
+    """uint8 NHWC batch -> float32 ``(x/255 - mean) / std``.
+
+    Native fused pass when the library is available, numpy fallback otherwise
+    (bit-identical up to float rounding; the test suite asserts closeness).
+    """
+    if batch_u8.dtype != np.uint8 or batch_u8.ndim != 4 or batch_u8.shape[-1] != 3:
+        raise ValueError(f"expected uint8 NHWC3 batch, got {batch_u8.dtype} {batch_u8.shape}")
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if mean.shape != (3,) or std.shape != (3,):
+        raise ValueError(
+            f"mean/std must have shape (3,), got {mean.shape} / {std.shape}"
+        )
+    if ensure_built():
+        batch_u8 = np.ascontiguousarray(batch_u8)
+        n, h, w, _ = batch_u8.shape
+        out = np.empty((n, h, w, 3), dtype=np.float32)
+        scale = (1.0 / (255.0 * std)).astype(np.float32)
+        bias = (-mean / std).astype(np.float32)
+        _lib.pdt_normalize_u8_nhwc(
+            batch_u8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            h * w,
+            scale.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            bias.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n_threads,
+        )
+        return out
+    return ((batch_u8.astype(np.float32) / 255.0) - mean) / std

@@ -52,7 +52,7 @@ def get_base_seed(default: int = 0) -> int:
     return _BASE_SEED if _BASE_SEED is not None else default
 
 
-def make_iter_dataloader(loader: Iterable) -> Generator[Tuple, None, None]:
+def make_iter_dataloader(loader: Iterable, start_iter: int = 0) -> Generator[Tuple, None, None]:
     """Convert an epoch-based loader into an infinite per-iteration generator.
 
     Reference contract (train_distributed.py:27, :249-252): the training loop
@@ -60,8 +60,23 @@ def make_iter_dataloader(loader: Iterable) -> Generator[Tuple, None, None]:
     batches forever.  Between epochs we advance the loader's epoch so the
     distributed shuffle re-randomizes (the analog of
     ``DistributedSampler.set_epoch``).
+
+    ``start_iter`` fast-forwards the stream to a checkpointed position
+    (epoch = start_iter // batches_per_epoch, then skip the remainder at the
+    index level) so a resumed run sees exactly the batch *indices* a straight
+    run would.  For index-seeded datasets (synthetic) this makes resume
+    bit-exact; for datasets with stochastic augmentation driven by the global
+    host RNG (ImageFolder crop/flip) the skipped decodes don't consume RNG
+    draws, so augmented pixels after resume differ from a hypothetical
+    uninterrupted run — sample identity and visit order are still exact.
     """
     epoch = 0
+    if start_iter:
+        batches_per_epoch = len(loader)
+        epoch = start_iter // batches_per_epoch
+        skip = start_iter % batches_per_epoch
+        if skip and hasattr(loader, "skip_next"):
+            loader.skip_next(skip)
     while True:
         if hasattr(loader, "set_epoch"):
             loader.set_epoch(epoch)
