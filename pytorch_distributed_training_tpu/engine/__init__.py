@@ -3,11 +3,13 @@
 Replaces the reference's L4/L6 layers (``Runner`` process orchestration and
 the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
+from .profiling import TraceProfiler
 from .runner import Runner
 from .steps import TrainState, build_eval_step, build_train_step, init_train_state
 
 __all__ = [
     "Runner",
+    "TraceProfiler",
     "TrainState",
     "build_train_step",
     "build_eval_step",

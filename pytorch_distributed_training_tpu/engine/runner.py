@@ -59,6 +59,7 @@ from ..parallel import (
 from ..schedulers import get_scheduler
 from ..utils import make_deterministic, make_iter_dataloader
 from .checkpoint import Checkpointer
+from .profiling import TraceProfiler
 from .steps import build_eval_step, build_train_step, init_train_state
 
 __all__ = ["Runner"]
@@ -274,12 +275,21 @@ class Runner:
                     "clear the directory or point checkpoint.dir elsewhere"
                 )
 
+        # --- optional jax.profiler trace window (absent in reference; §5.1) --
+        self.profiler = (
+            TraceProfiler.from_config(train_cfg, self.logger)
+            if self.current_rank == 0
+            else None
+        )
+
         iter_generator = make_iter_dataloader(train_loader, start_iter=self.iter)
 
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
             img, label = next(iter_generator)
             self.train_iter(img, label)
+            if self.profiler:
+                self.profiler.after_step(self.iter, sync=self.state)
 
             def is_val():
                 p1 = self.iter != 0
@@ -288,12 +298,20 @@ class Runner:
                 return (p1 and p2) or p3
 
             if is_val():
+                # keep validation (and checkpoint I/O below) out of the trace:
+                # the window is a bounded steady-state sample of train steps
+                if self.profiler:
+                    self.profiler.stop(sync=self.state)
                 self.validate()
             if self.checkpointer and self.checkpointer.should_save(
                 self.iter, train_cfg["train_iters"]
             ):
+                if self.profiler:
+                    self.profiler.stop(sync=self.state)
                 self.checkpointer.save(self.iter, self.state)
             self.iter += 1
+        if self.profiler:
+            self.profiler.finalize()
         if self.checkpointer:
             self.checkpointer.wait()
             self.checkpointer.close()

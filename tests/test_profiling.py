@@ -1,0 +1,69 @@
+"""Config-gated jax.profiler trace hooks (SURVEY.md §5.1 rebuild item)."""
+import os
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.engine import TraceProfiler
+
+
+def test_from_config_absent_returns_none():
+    assert TraceProfiler.from_config({"batch_size": 16}) is None
+    assert TraceProfiler.from_config({"profile": None}) is None
+
+
+def test_trace_window_produces_profile(tmp_path):
+    prof_dir = str(tmp_path / "trace")
+    prof = TraceProfiler.from_config(
+        {"profile": {"dir": prof_dir, "start_iter": 2, "n_iters": 3}}
+    )
+    assert prof is not None and prof.start_iter == 2 and prof.n_iters == 3
+
+    f = jax.jit(lambda x: jnp.sin(x) @ x)
+    x = jnp.ones((64, 64))
+    for it in range(8):
+        jax.block_until_ready(f(x))
+        prof.after_step(it)
+    prof.stop()  # idempotent: window already closed at iter 4
+
+    # jax.profiler writes plugins/profile/<timestamp>/*.xplane.pb under dir
+    found = [
+        os.path.join(dp, fn)
+        for dp, _, fns in os.walk(prof_dir)
+        for fn in fns
+    ]
+    assert found, f"no trace files written under {prof_dir}"
+
+
+def test_from_config_bad_values(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError, match="must be a mapping"):
+        TraceProfiler.from_config({"profile": True})
+    with pytest.raises(ValueError, match="profile.dir is required"):
+        TraceProfiler.from_config({"profile": {"start_iter": 3}})
+
+
+def test_zero_capture_close_rearms(tmp_path):
+    """A stop() that caught no iterations (e.g. validation fired the moment
+    the window opened) discards the window and retries afterwards."""
+    prof = TraceProfiler(str(tmp_path / "t3"), start_iter=2, n_iters=2)
+    prof.after_step(2)          # opens
+    prof.stop()                 # interruption before any traced iteration
+    assert not prof._active and not prof._done  # re-armed
+    prof.after_step(3)          # reopens
+    assert prof._active
+    prof.after_step(4)
+    prof.after_step(5)          # 5 >= 3+2 -> closes, 2 iterations captured
+    assert prof._done
+    prof.finalize()             # idempotent
+
+
+def test_window_opens_once(tmp_path):
+    prof = TraceProfiler(str(tmp_path / "t2"), start_iter=0, n_iters=1)
+    prof.after_step(0)  # opens: traces iteration 1
+    assert prof._active and not prof._done
+    prof.after_step(1)  # closes after the traced iteration completes
+    assert prof._done and not prof._active
+    prof.after_step(2)  # no reopen
+    assert not prof._active
