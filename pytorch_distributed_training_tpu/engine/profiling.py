@@ -52,8 +52,10 @@ class TraceProfiler:
     ) -> Optional["TraceProfiler"]:
         """Build from the ``training.profile`` config section (None if absent)."""
         prof_cfg = train_cfg.get("profile")
-        if not prof_cfg:
+        if prof_cfg is None or prof_cfg is False:
             return None
+        # an empty mapping is a *misconfiguration* (user enabled the section
+        # but gave no keys) — fall through so the 'dir' check raises
         if not isinstance(prof_cfg, Mapping):
             raise ValueError(
                 f"training.profile must be a mapping with a 'dir' key, got {prof_cfg!r}"

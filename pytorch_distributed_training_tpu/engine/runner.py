@@ -309,6 +309,10 @@ class Runner:
                 if self.profiler:
                     self.profiler.stop(sync=self.state)
                 self.checkpointer.save(self.iter, self.state)
+                if self.profiler:
+                    # orbax saves are async — block until the write finishes
+                    # so the window can't reopen over in-flight checkpoint I/O
+                    self.checkpointer.wait()
             self.iter += 1
         if self.profiler:
             self.profiler.finalize()

@@ -7,9 +7,10 @@ The reference gets these capabilities from PyTorch C++/CUDA natives
     batch normalization via in-graph ``lax.pmean`` (reference:
     ``torch.nn.SyncBatchNorm`` C++/NCCL kernels, train_distributed.py:196-197).
   - :mod:`.losses` — cross-entropy matching ``torch.nn.CrossEntropyLoss``
-    (train_distributed.py:202).
+    (train_distributed.py:202); on TPU it dispatches to the Pallas-fused
+    kernel in :mod:`.fused_ce`.
 """
 from .batch_norm import DistributedBatchNorm
-from .losses import cross_entropy_loss
+from .losses import cross_entropy_loss, cross_entropy_loss_xla
 
-__all__ = ["DistributedBatchNorm", "cross_entropy_loss"]
+__all__ = ["DistributedBatchNorm", "cross_entropy_loss", "cross_entropy_loss_xla"]

@@ -6,14 +6,16 @@ over the batch.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["cross_entropy_loss"]
+__all__ = ["cross_entropy_loss", "cross_entropy_loss_xla"]
 
 
-def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Mean softmax cross-entropy with integer labels.
+def cross_entropy_loss_xla(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels (plain XLA lowering).
 
     Matches ``torch.nn.CrossEntropyLoss`` defaults (mean reduction, no label
     smoothing).  Computed in float32 regardless of the (possibly bf16) logits
@@ -24,3 +26,19 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     logz = jax.nn.logsumexp(logits, axis=-1)
     true_logit = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
     return jnp.mean(logz - true_logit)
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax CE — Pallas-fused on TPU, XLA lowering elsewhere.
+
+    Same semantics either way (see :func:`cross_entropy_loss_xla`); the
+    fused kernel (:mod:`.fused_ce`) does the row-wise softmax pipeline in
+    one VMEM pass, forward and backward.  ``PDT_DISABLE_PALLAS=1`` forces
+    the XLA path (checked at trace time — both paths compile to static
+    programs).
+    """
+    if jax.default_backend() == "tpu" and not os.environ.get("PDT_DISABLE_PALLAS"):
+        from .fused_ce import fused_cross_entropy
+
+        return fused_cross_entropy(logits, labels)
+    return cross_entropy_loss_xla(logits, labels)

@@ -17,6 +17,7 @@ from .mesh import (
     make_mesh,
     replicated_sharding,
 )
+from .sequence import SEQUENCE_AXIS, ring_attention, ulysses_attention
 
 __all__ = [
     "initialize_distributed",
@@ -27,4 +28,7 @@ __all__ = [
     "replicated_sharding",
     "DATA_AXIS",
     "MODEL_AXIS",
+    "SEQUENCE_AXIS",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,159 @@
+"""Sequence / context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no sequence axis anywhere (image classification,
+SURVEY.md §5.7), but the framework treats long-context as first-class: both
+standard sequence-parallel attention strategies are provided as pure SPMD
+collectives usable inside ``shard_map`` over a mesh ``sequence`` axis:
+
+  - :func:`ring_attention` — blockwise (flash-style) attention with K/V
+    blocks rotating around the device ring via ``lax.ppermute``.  Each of
+    the N ring steps overlaps the neighbor exchange with the local
+    QK^T/softmax/PV block work; memory per device stays O(S_local), so the
+    attainable context length scales linearly with the ring size.  This is
+    the Ring Attention construction (Liu et al., 2023) on XLA collectives:
+    the ``ppermute`` lowers to ICI neighbor DMA on TPU.
+  - :func:`ulysses_attention` — DeepSpeed-Ulysses-style all-to-all: resharding
+    [B, S/n, H, D] -> [B, S, H/n, D] with ``lax.all_to_all``, local full
+    attention over heads, inverse all-to-all back to sequence sharding.
+    Cheaper at moderate S (two all-to-alls vs N-1 permutes) but caps
+    parallelism at the head count.
+
+Numerics: accumulation in float32 with the online-softmax recurrence
+(max-shifted), output cast back to the query dtype.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_attention", "ulysses_attention", "SEQUENCE_AXIS"]
+
+SEQUENCE_AXIS = "sequence"
+
+from ..utils.vma import mark_varying
+
+_NEG_INF = float("-inf")
+
+
+def _block_attn(q, k, v, scale, q_off, k_off, causal, m, l, o):
+    """One online-softmax accumulation step against a single K/V block.
+
+    q: [B, Sq, H, D]; k, v: [B, Sk, H, D]; m, l: [B, H, Sq] f32 running
+    max / normalizer; o: [B, Sq, H, D] f32 unnormalized output accumulator.
+    ``q_off``/``k_off`` are the global positions of the blocks' first tokens
+    (for the causal mask).
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[1])
+        k_pos = k_off + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # A fully-masked row keeps m_new == -inf; exp(-inf - -inf) is NaN, so
+    # gate both correction factors on finiteness (the row contributes 0).
+    finite = jnp.isfinite(m_new)
+    alpha = jnp.where(finite, jnp.exp(m - m_new), 0.0)  # [B, H, Sq]
+    p = jnp.where(finite[..., None], jnp.exp(s - m_new[..., None]), 0.0)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded across a device ring.
+
+    Must be called inside ``shard_map`` with ``axis_name`` bound in the mesh.
+    Block layout: the global sequence is sharded contiguously — device ``i``
+    holds tokens ``[i*S_local, (i+1)*S_local)``.
+
+    Args:
+      q, k, v: local shards ``[batch, seq_local, heads, head_dim]``.
+      causal: apply a causal mask over *global* positions.
+    Returns:
+      ``[batch, seq_local, heads, head_dim]`` in ``q.dtype``.
+    """
+    n = jax.lax.psum(1, axis_name)  # static axis size
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    m0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    # constants start device-invariant; the loop body makes them vary over
+    # the ring axis, so the carry types only match if we pre-mark them
+    m0, l0, o0 = mark_varying((m0, l0, o0), (axis_name,))
+    # receive from the right neighbor: after i rotations we hold block idx+i
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (idx + i) % n
+        m, l, o = _block_attn(
+            q, k_cur, v_cur, scale, idx * s_local, src * s_local, causal, m, l, o
+        )
+        # rotate even on the last step: K/V return home, so the carry shape
+        # and ownership are invariant (and XLA overlaps the permute with the
+        # independent block compute above).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
+    l_t = l.transpose(0, 2, 1)[..., None]  # [B, Sq, H, 1]
+    out = jnp.where(l_t > 0, o / jnp.maximum(l_t, 1e-37), 0.0)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses construction).
+
+    Reshards ``[B, S/n, H, D] -> [B, S, H/n, D]`` (heads must divide by the
+    axis size), runs *local* full attention per head group, then reshards
+    back.  Two ``all_to_all`` collectives total; on TPU they ride ICI.
+    """
+    n = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    if h % n != 0:
+        raise ValueError(f"heads ({h}) must be divisible by the axis size ({n})")
+
+    def scatter_heads(x):  # [B, S/n, H, D] -> [B, S, H/n, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather_heads(x):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", qg.astype(jnp.float32), kg.astype(jnp.float32)
+    ) * scale
+    if causal:
+        s_full = s.shape[-1]
+        pos = jnp.arange(s_full)
+        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
+    return gather_heads(out.astype(q.dtype))

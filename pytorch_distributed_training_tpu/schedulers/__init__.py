@@ -30,7 +30,14 @@ from typing import Any, Callable, Dict, List, Sequence
 
 import jax.numpy as jnp
 
-__all__ = ["multi_step_lr", "get_scheduler", "IterationScheduler", "SCHEDULERS"]
+__all__ = [
+    "multi_step_lr",
+    "poly_lr",
+    "cosine_lr",
+    "get_scheduler",
+    "IterationScheduler",
+    "SCHEDULERS",
+]
 
 
 def _warmup_factor(step, warmup_iters: int, warmup_mode: str, warmup_factor: float):
@@ -64,19 +71,84 @@ def multi_step_lr(
         if isinstance(step, int):
             # host path (get_last_lr logging): full float64 precision
             lr = base_lr * gamma ** sum(1 for m in ms_sorted if step >= m)
-            if warmup_iters and warmup_iters > 0 and step < warmup_iters:
-                if warmup_mode == "linear":
-                    alpha = step / warmup_iters
-                    lr *= warmup_factor * (1.0 - alpha) + alpha
-                elif warmup_mode == "constant":
-                    lr *= warmup_factor
-                else:
-                    raise ValueError(f"unknown warmup_mode: {warmup_mode!r}")
-            return lr
-        lr = base_lr * gamma ** jnp.sum(step >= ms)
-        if warmup_iters and warmup_iters > 0:
-            lr = lr * _warmup_factor(step, warmup_iters, warmup_mode, warmup_factor)
+        else:
+            lr = base_lr * gamma ** jnp.sum(step >= ms)
+        return _apply_warmup(lr, step, warmup_iters, warmup_mode, warmup_factor)
+
+    return lr_at
+
+
+def _apply_warmup(lr, step, warmup_iters: int, warmup_mode: str, warmup_factor: float):
+    """Shared host/traced warmup application for the decay schedules below."""
+    if not warmup_iters or warmup_iters <= 0:
         return lr
+    if isinstance(step, int):
+        if step >= warmup_iters:
+            return lr
+        if warmup_mode == "linear":
+            alpha = step / warmup_iters
+            return lr * (warmup_factor * (1.0 - alpha) + alpha)
+        if warmup_mode == "constant":
+            return lr * warmup_factor
+        raise ValueError(f"unknown warmup_mode: {warmup_mode!r}")
+    return lr * _warmup_factor(step, warmup_iters, warmup_mode, warmup_factor)
+
+
+def poly_lr(
+    base_lr: float,
+    total_iters: int,
+    power: float = 2.0,
+    end_lr: float = 0.0,
+    warmup_iters: int = 0,
+    warmup_mode: str = "linear",
+    warmup_factor: float = 1.0 / 3,
+) -> Callable[[Any], Any]:
+    """Polynomial decay over iterations — the large-batch LARS recipe's
+    schedule (MLPerf ResNet uses power=2 with linear warmup).
+
+    ``lr(s) = end + (base - end) * (1 - s/total)^power`` after warmup, with
+    the decay horizon measured over the *post-warmup* iterations so the decay
+    starts from ``base_lr`` exactly when warmup hands over.
+    """
+    decay_iters = max(total_iters - max(warmup_iters, 0), 1)
+
+    def lr_at(step):
+        if isinstance(step, int):
+            s = min(max(step - max(warmup_iters, 0), 0), decay_iters)
+            frac = (1.0 - s / decay_iters) ** power
+            lr = end_lr + (base_lr - end_lr) * frac
+            return _apply_warmup(lr, step, warmup_iters, warmup_mode, warmup_factor)
+        s = jnp.clip(step - max(warmup_iters, 0), 0, decay_iters)
+        frac = (1.0 - s / decay_iters) ** power
+        lr = end_lr + (base_lr - end_lr) * frac
+        return _apply_warmup(lr, step, warmup_iters, warmup_mode, warmup_factor)
+
+    return lr_at
+
+
+def cosine_lr(
+    base_lr: float,
+    total_iters: int,
+    end_lr: float = 0.0,
+    warmup_iters: int = 0,
+    warmup_mode: str = "linear",
+    warmup_factor: float = 1.0 / 3,
+) -> Callable[[Any], Any]:
+    """Cosine decay over iterations (+ optional warmup), post-warmup horizon."""
+    import math
+
+    decay_iters = max(total_iters - max(warmup_iters, 0), 1)
+
+    def lr_at(step):
+        if isinstance(step, int):
+            s = min(max(step - max(warmup_iters, 0), 0), decay_iters)
+            cos = 0.5 * (1.0 + math.cos(math.pi * s / decay_iters))
+            lr = end_lr + (base_lr - end_lr) * cos
+            return _apply_warmup(lr, step, warmup_iters, warmup_mode, warmup_factor)
+        s = jnp.clip(step - max(warmup_iters, 0), 0, decay_iters)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * s / decay_iters))
+        lr = end_lr + (base_lr - end_lr) * cos
+        return _apply_warmup(lr, step, warmup_iters, warmup_mode, warmup_factor)
 
     return lr_at
 
@@ -114,8 +186,35 @@ def _make_multi_step(optimizer, cfg: Dict[str, Any]) -> IterationScheduler:
     return IterationScheduler(lr_fn)
 
 
+def _make_poly(optimizer, cfg: Dict[str, Any]) -> IterationScheduler:
+    lr_fn = poly_lr(
+        base_lr=optimizer.lr,
+        total_iters=cfg["total_iters"],
+        power=cfg.get("power", 2.0),
+        end_lr=cfg.get("end_lr", 0.0),
+        warmup_iters=cfg.get("warmup_iters", 0),
+        warmup_mode=cfg.get("warmup_mode", "linear"),
+        warmup_factor=cfg.get("warmup_factor", 1.0 / 3),
+    )
+    return IterationScheduler(lr_fn)
+
+
+def _make_cosine(optimizer, cfg: Dict[str, Any]) -> IterationScheduler:
+    lr_fn = cosine_lr(
+        base_lr=optimizer.lr,
+        total_iters=cfg["total_iters"],
+        end_lr=cfg.get("end_lr", 0.0),
+        warmup_iters=cfg.get("warmup_iters", 0),
+        warmup_mode=cfg.get("warmup_mode", "linear"),
+        warmup_factor=cfg.get("warmup_factor", 1.0 / 3),
+    )
+    return IterationScheduler(lr_fn)
+
+
 SCHEDULERS = {
     "multi_step": _make_multi_step,
+    "poly": _make_poly,
+    "cosine": _make_cosine,
 }
 
 

@@ -1,0 +1,31 @@
+"""shard_map varying-manual-axes (vma) compatibility shim.
+
+JAX's API for promoting a mesh-invariant value to "varying over axis"
+moved between versions (``jax.lax.pvary`` -> ``jax.lax.pcast(...,
+to='varying')``).  Both the ring-attention collective and the Pallas fused
+CE need it; this is the single shared implementation so the two can't drift
+onto different code paths.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+
+__all__ = ["mark_varying"]
+
+
+def mark_varying(tree, axis_names: Sequence[str]):
+    """Mark every array in ``tree`` as varying over ``axis_names``.
+
+    No-op when ``axis_names`` is empty or the running JAX predates vma
+    typing (neither API exists).
+    """
+    axes = tuple(axis_names)
+    if not axes:
+        return tree
+    if hasattr(jax.lax, "pvary"):
+        return jax.tree.map(lambda x: jax.lax.pvary(x, axes), tree)
+    if hasattr(jax.lax, "pcast"):
+        return jax.tree.map(lambda x: jax.lax.pcast(x, axes, to="varying"), tree)
+    return tree

@@ -36,7 +36,13 @@ def test_roundtrip(tmp_path):
 def test_reference_configs_validate():
     """Our shipped configs follow the reference schema exactly."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for name in ["ResNet50.yml", "test-sync.yml"]:
+    for name in [
+        "ResNet50.yml",
+        "test-sync.yml",
+        "ResNet101-syncbn.yml",
+        "ResNet152-bf16.yml",
+        "ResNet50-lars8k.yml",
+    ]:
         path = os.path.join(here, "config", name)
         if os.path.exists(path):
             cfg = get_cfg(path)

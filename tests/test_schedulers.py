@@ -6,7 +6,12 @@ so milestones are iteration counts (SURVEY.md §7 hard part #1).
 import numpy as np
 
 from pytorch_distributed_training_tpu.optimizers import SGD
-from pytorch_distributed_training_tpu.schedulers import get_scheduler, multi_step_lr
+from pytorch_distributed_training_tpu.schedulers import (
+    cosine_lr,
+    get_scheduler,
+    multi_step_lr,
+    poly_lr,
+)
 
 
 def test_multi_step_matches_torch():
@@ -49,3 +54,45 @@ def test_constant_warmup():
     assert np.isclose(float(fn(0)), 0.25)
     assert np.isclose(float(fn(3)), 0.25)
     assert np.isclose(float(fn(4)), 1.0)
+
+
+def test_poly_decay():
+    fn = poly_lr(10.0, total_iters=100, power=2.0, warmup_iters=0)
+    assert np.isclose(float(fn(0)), 10.0)
+    assert np.isclose(float(fn(50)), 10.0 * 0.25)
+    assert np.isclose(float(fn(100)), 0.0)
+    assert np.isclose(float(fn(200)), 0.0)  # clamps past horizon
+    # traced path agrees with host path
+    import jax.numpy as jnp
+
+    for s in [0, 13, 50, 99, 100]:
+        assert np.isclose(float(fn(jnp.asarray(s))), float(fn(s)), atol=1e-6)
+
+
+def test_poly_warmup_handoff():
+    """Decay horizon is post-warmup: lr == base exactly at warmup end."""
+    fn = poly_lr(8.0, total_iters=110, power=2.0, warmup_iters=10, warmup_factor=0.0)
+    assert np.isclose(float(fn(0)), 0.0)
+    assert np.isclose(float(fn(10)), 8.0)
+    assert np.isclose(float(fn(110)), 0.0)
+
+
+def test_cosine_decay():
+    fn = cosine_lr(1.0, total_iters=100, end_lr=0.1)
+    assert np.isclose(float(fn(0)), 1.0)
+    assert np.isclose(float(fn(50)), 0.55)  # midpoint of [0.1, 1.0]
+    assert np.isclose(float(fn(100)), 0.1)
+    import jax.numpy as jnp
+
+    for s in [0, 27, 50, 100]:
+        assert np.isclose(float(fn(jnp.asarray(s))), float(fn(s)), atol=1e-6)
+
+
+def test_factory_poly_cosine():
+    opt = SGD(lr=10.0, momentum=0.9)
+    sched = get_scheduler(
+        opt, {"name": "poly", "total_iters": 100, "power": 2.0, "warmup_iters": 0}
+    )
+    assert np.isclose(sched.get_last_lr()[0], 10.0)
+    sched = get_scheduler(opt, {"name": "cosine", "total_iters": 100})
+    assert np.isclose(sched.get_last_lr()[0], 10.0)
