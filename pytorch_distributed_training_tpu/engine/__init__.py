@@ -5,6 +5,7 @@ the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
 from .profiling import TraceProfiler
 from .runner import Runner
+from .sp_steps import build_lm_train_step
 from .steps import TrainState, build_eval_step, build_train_step, init_train_state
 
 __all__ = [
@@ -13,5 +14,6 @@ __all__ = [
     "TrainState",
     "build_train_step",
     "build_eval_step",
+    "build_lm_train_step",
     "init_train_state",
 ]

@@ -1,0 +1,115 @@
+"""Compiled sequence-parallel (long-context) LM training step.
+
+The DP step in :mod:`.steps` shards the *batch*; this step additionally
+shards the *sequence* over a second mesh axis, the TPU-native analog of
+ring-attention context parallelism: one compiled SPMD program in which
+attention streams K/V blocks around the sequence ring (``ppermute`` over
+ICI) while every other component stays per-token local.
+
+Gradient math (why this is exact): the objective is the per-token CE summed
+locally, normalized by the GLOBAL token count, and ``psum``-reduced over
+(data, sequence) *inside the differentiated function* — i.e. the true
+global mean loss as a replicated scalar.  Differentiating it gives the
+exact global gradient with no post-grad collective: every local
+contribution is a partial sum (token embeddings and position slices touch
+disjoint rows, transformer weights accumulate only local-token terms, and
+attention K/V cotangents ride the ring back to their owners), and
+shard_map's AD transpose psums the replicated params' cotangent across the
+mesh.  No special-casing per parameter, unlike pooled classifiers where
+post-reduction params would behave differently.
+
+Batch layout: ``tokens``/``labels`` are ``[global_batch, global_seq]``
+sharded ``P(data, sequence)``.  Labels are the host-shifted next tokens
+(the shift crosses shard boundaries, so it must happen before sharding).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import cross_entropy_loss
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.sequence import SEQUENCE_AXIS
+from .steps import TrainState
+
+__all__ = ["build_lm_train_step", "lm_loss_local"]
+
+
+def lm_loss_local(logits, labels, global_tokens: int):
+    """Local partial loss: sum of per-token CE / global token count (fp32).
+
+    Routes through :func:`..ops.cross_entropy_loss` (token-flattened), so the
+    [B*S, V] softmax-CE — the largest CE in the framework — hits the Pallas
+    fused kernel on TPU; the local mean is rescaled to the global-sum
+    normalization the SP gradient math needs.
+    """
+    vocab = logits.shape[-1]
+    local_mean = cross_entropy_loss(
+        logits.reshape(-1, vocab), labels.reshape(-1)
+    )
+    return local_mean * (labels.size / global_tokens)
+
+
+def build_lm_train_step(
+    model,
+    optimizer,
+    lr_fn: Callable,
+    mesh: Mesh,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQUENCE_AXIS,
+    donate: bool = True,
+):
+    """Compile one DP x SP training iteration for a :class:`TransformerLM`.
+
+    ``model.seq_axis`` must equal ``seq_axis`` (the module runs its ring
+    attention over that mesh axis); ``mesh`` must carry both axes.
+    """
+    axes = (data_axis, seq_axis)
+    n_data = mesh.shape[data_axis]
+    n_seq = mesh.shape[seq_axis]
+
+    def body(params, opt_state, tokens, labels):
+        b_local, s_local = tokens.shape
+        global_tokens = b_local * s_local * n_data * n_seq
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            # objective = GLOBAL mean CE per token: psum of the local partial
+            # sums (each already /global_tokens).  Differentiating this
+            # replicated scalar yields the exact global gradient directly —
+            # shard_map's AD transpose psums the replicated params' cotangent
+            # across both mesh axes (an explicit post-grad psum would
+            # double-count; regression-tested in tests/test_transformer_lm.py)
+            return jax.lax.psum(
+                lm_loss_local(logits, labels, global_tokens), axes
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        lr = lr_fn(opt_state.step)
+        new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+        return new_params, new_opt, loss
+
+    rep = P()
+    tok_spec = P(data_axis, seq_axis)
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, rep, tok_spec, tok_spec),
+        out_specs=(rep, rep, rep),
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def train_step(state: TrainState, tokens, labels):
+        new_params, new_opt, loss = sharded(
+            state.params, state.opt_state, tokens, labels
+        )
+        return (
+            TrainState(params=new_params, batch_stats=state.batch_stats, opt_state=new_opt),
+            loss,
+        )
+
+    return train_step

@@ -97,13 +97,22 @@ def build_train_step(
                 train=True,
                 mutable=["batch_stats"],
             )
-            return cross_entropy_loss(out, label), mutated["batch_stats"]
+            loss = cross_entropy_loss(out, label)
+            # Make the OBJECTIVE the global-batch mean (each replica's CE is
+            # the mean over its local shard).  Differentiating this is the
+            # DDP-reducer equivalent: the cotangent of the replicated params
+            # is psum-reduced across the mesh by shard_map's AD transpose, so
+            # `grads` below is exactly the DDP-averaged gradient — an
+            # explicit post-grad collective would double-count the psum
+            # (world_size x too large; regression-tested in
+            # tests/test_engine.py::test_dp_step_matches_single_device).
+            # XLA still overlaps the underlying all-reduce with independent
+            # backward compute, like DDP's bucketed reducer (reference :198).
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            # models without batch statistics (e.g. ViT) mutate nothing
+            return loss, mutated.get("batch_stats", {})
 
         (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        # DDP-reducer equivalent: mean-reduce grads over the data axis.  XLA
-        # schedules this all-reduce concurrently with independent compute.
-        grads = jax.lax.pmean(grads, DATA_AXIS)
-        loss = jax.lax.pmean(loss, DATA_AXIS)
         if not sync_bn:
             # Local BN stats diverge per replica; average them so the state
             # stays replicated (the reference's DDP broadcast_buffers keeps

@@ -4,6 +4,10 @@ Re-provides ``dl_lib.classification.models.get_model`` (reference import at
 train_distributed.py:25, call at :183-186): ``get_model(model_name,
 num_classes) -> model``.  Case-insensitive on the name; the reference configs
 use ``ResNet50`` (config/ResNet50.yml:31).
+
+Families: the reference's ResNet-18/34/50/101/152 (README.md:7-13) plus a
+ViT family (ViT-Ti16/S16/B16) added beyond the reference — the config
+surface only pins ``model.name``, so new names slot straight in.
 """
 from __future__ import annotations
 
@@ -12,14 +16,23 @@ from typing import Any, Optional
 import jax.numpy as jnp
 
 from .resnet import RESNET_CONFIGS, BasicBlock, Bottleneck, ResNet
+from .vit import VIT_CONFIGS, ViT
 
-__all__ = ["get_model", "list_models", "ResNet", "BasicBlock", "Bottleneck"]
+__all__ = [
+    "get_model",
+    "list_models",
+    "ResNet",
+    "BasicBlock",
+    "Bottleneck",
+    "ViT",
+]
 
 _CANONICAL = {name.lower(): name for name in RESNET_CONFIGS}
+_CANONICAL.update({name.lower(): name for name in VIT_CONFIGS})
 
 
 def list_models():
-    return sorted(RESNET_CONFIGS)
+    return sorted(RESNET_CONFIGS) + sorted(VIT_CONFIGS)
 
 
 def get_model(
@@ -27,22 +40,35 @@ def get_model(
     num_classes: int,
     axis_name: Optional[str] = None,
     dtype: Any = jnp.float32,
-) -> ResNet:
+):
     """Build a model by zoo name (reference: train_distributed.py:183-186).
 
     Extra TPU-native knobs beyond the reference signature (both keyword-only
     in spirit; the engine wires them from config):
-      axis_name: mesh axis for SyncBN (``sync_bn: True`` => the data axis).
+      axis_name: mesh axis for SyncBN (``sync_bn: True`` => the data axis;
+        models without batch statistics accept and ignore it).
       dtype: compute dtype (bf16 mixed precision).
     """
     key = model_name.lower()
     if key not in _CANONICAL:
         raise KeyError(f"unknown model '{model_name}' (have: {list_models()})")
-    block_cls, stage_sizes = RESNET_CONFIGS[_CANONICAL[key]]
-    return ResNet(
-        stage_sizes=stage_sizes,
-        block_cls=block_cls,
+    name = _CANONICAL[key]
+    if name in RESNET_CONFIGS:
+        block_cls, stage_sizes = RESNET_CONFIGS[name]
+        return ResNet(
+            stage_sizes=stage_sizes,
+            block_cls=block_cls,
+            num_classes=num_classes,
+            axis_name=axis_name,
+            dtype=dtype,
+        )
+    patch, embed, depth, heads = VIT_CONFIGS[name]
+    return ViT(
         num_classes=num_classes,
+        patch_size=patch,
+        embed_dim=embed,
+        depth=depth,
+        num_heads=heads,
         axis_name=axis_name,
         dtype=dtype,
     )

@@ -1,0 +1,112 @@
+"""Decoder-only transformer LM — the long-context / sequence-parallel model.
+
+Beyond the reference (image classification only, SURVEY.md §5.7), but
+required by the framework's first-class long-context mandate: a GPT-style
+causal LM whose every component is *per-token*, which is what makes
+sequence parallelism exact — with the loss summed per token and normalized
+by the global token count, every parameter gradient is a partial sum, and
+one ``psum`` over the (data, sequence) axes reconstructs the exact global
+gradient (see ``engine.sp_steps``).
+
+With ``seq_axis`` set the model must run inside ``shard_map`` with that
+mesh axis in scope, taking token shards ``[B, S/n]``; attention runs as
+ring attention (or Ulysses) over the axis, and the position embedding is
+sliced to the shard via ``lax.axis_index``.  With ``seq_axis=None`` the
+same module is an ordinary single-shard LM — the two configurations share
+identical parameter shapes, so init happens once (unsharded) and the params
+are fed to the sharded step.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import MultiHeadAttention
+from .vit import MLP
+
+__all__ = ["TransformerLM"]
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: float
+    seq_axis: Optional[str]
+    seq_impl: str
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + MultiHeadAttention(
+            num_heads=self.num_heads,
+            causal=True,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
+            dtype=self.dtype,
+            name="attn",
+        )(y)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        return x + MLP(
+            hidden=int(dim * self.mlp_ratio), out=dim, dtype=self.dtype, name="mlp"
+        )(y)
+
+
+class TransformerLM(nn.Module):
+    """Causal LM over integer tokens ``[B, S(_local)] -> logits [B, S, V]``."""
+
+    vocab_size: int
+    max_len: int
+    embed_dim: int = 256
+    depth: int = 4
+    num_heads: int = 8
+    mlp_ratio: float = 4.0
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        emb = self.param(
+            "tok_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.vocab_size, self.embed_dim),
+            jnp.float32,
+        )
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (self.max_len, self.embed_dim),
+            jnp.float32,
+        )
+        x = jnp.take(emb, tokens, axis=0).astype(self.dtype)
+        if self.seq_axis is not None and not self.is_initializing():
+            # local shard i holds global positions [i*s, (i+1)*s)
+            n_seq = jax.lax.psum(1, self.seq_axis)  # static axis size
+            if s * n_seq > self.max_len:
+                # dynamic_slice would clamp silently, giving shards beyond
+                # max_len the SAME position rows — fail loudly instead
+                raise ValueError(
+                    f"global sequence {s * n_seq} (= {s} local x {n_seq} shards)"
+                    f" exceeds max_len {self.max_len}"
+                )
+            off = jax.lax.axis_index(self.seq_axis) * s
+            pe = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
+        else:
+            pe = pos[:s]
+        x = x + pe[None].astype(self.dtype)
+        for i in range(self.depth):
+            x = DecoderBlock(
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                seq_axis=self.seq_axis if not self.is_initializing() else None,
+                seq_impl=self.seq_impl,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

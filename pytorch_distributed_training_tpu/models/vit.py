@@ -1,0 +1,122 @@
+"""Vision Transformer family (ViT-Ti/S/B/16) in Flax linen.
+
+An addition beyond the reference (its zoo is the ResNet family only,
+/root/reference/README.md:7-13; the config surface pins only
+``model.name``, config/ResNet50.yml:31, so new names slot into the same
+``get_model`` factory).  Topology follows the standard ViT (Dosovitskiy et
+al., 2020) / torchvision ``vit_b_16`` layout: conv patch embedding, learned
+class token + position embeddings, pre-LN encoder blocks (MHA + GELU MLP),
+final LayerNorm, linear head.
+
+TPU-native notes: NHWC input like the ResNets; the patch embedding is a
+stride=patch conv (one MXU matmul per patch grid); everything else is
+LayerNorm/Dense/attention — no BatchNorm, so ``sync_bn`` has nothing to do
+(the ``axis_name`` plumbed by ``get_model`` is accepted and unused).
+Attention runs through :class:`..ops.attention.MultiHeadAttention`; for
+sequence-parallel long-context training see :mod:`.transformer_lm`, where
+the per-token loss makes the sharding exact.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import MultiHeadAttention
+
+__all__ = ["ViT", "VIT_CONFIGS"]
+
+
+class MLP(nn.Module):
+    hidden: int
+    out: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
+        x = nn.gelu(x)
+        return nn.Dense(self.out, dtype=self.dtype, name="fc2")(x)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: float
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + MultiHeadAttention(
+            num_heads=self.num_heads, dtype=self.dtype, name="attn"
+        )(y)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        return x + MLP(
+            hidden=int(dim * self.mlp_ratio), out=dim, dtype=self.dtype, name="mlp"
+        )(y)
+
+
+class ViT(nn.Module):
+    """Standard ViT classifier.
+
+    Attributes follow torchvision's ``VisionTransformer`` naming where a
+    counterpart exists.  ``axis_name`` is accepted for ``get_model``
+    interface parity with the ResNets (SyncBN axis) and is unused — ViT has
+    no batch statistics.
+    """
+
+    num_classes: int
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    axis_name: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        ps = self.patch_size
+        b, h, w, _ = x.shape
+        if h % ps or w % ps:
+            raise ValueError(f"image {h}x{w} not divisible by patch size {ps}")
+        x = x.astype(self.dtype)
+        p = nn.Conv(
+            self.embed_dim, (ps, ps), strides=(ps, ps),
+            padding="VALID", dtype=self.dtype, name="patch_embed",
+        )(x)
+        tokens = p.reshape(b, -1, self.embed_dim)
+        cls = self.param(
+            "cls_token", nn.initializers.zeros, (1, 1, self.embed_dim), jnp.float32
+        )
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(cls.astype(self.dtype), (b, 1, self.embed_dim)), tokens],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (1, tokens.shape[1], self.embed_dim),
+            jnp.float32,
+        )
+        x = tokens + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = EncoderBlock(
+                num_heads=self.num_heads,
+                mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
+        # classification on the class token (torchvision ViT convention)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+# name -> (patch, embed, depth, heads); ViT-B/16 matches torchvision vit_b_16
+VIT_CONFIGS: dict[str, Tuple[int, int, int, int]] = {
+    "ViT-Ti16": (16, 192, 12, 3),
+    "ViT-S16": (16, 384, 12, 6),
+    "ViT-B16": (16, 768, 12, 12),
+}

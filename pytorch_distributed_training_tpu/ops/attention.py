@@ -1,0 +1,85 @@
+"""Multi-head attention with pluggable sequence-parallel strategies.
+
+An addition beyond the reference (its zoo is ResNets only, SURVEY.md §5.7 —
+no attention anywhere); this op is the compute core of the transformer
+family in :mod:`..models.vit` and the consumer of the sequence-parallel
+collectives in :mod:`..parallel.sequence`.
+
+Strategy selection is static (trace-time):
+
+  - ``seq_axis=None``         — plain full attention on the local shard
+                                (sequence replicated or short),
+  - ``seq_impl="ring"``       — ring attention over the ``seq_axis`` mesh
+                                axis (O(S_local) memory, ICI neighbor DMA),
+  - ``seq_impl="ulysses"``    — all-to-all head-parallel attention.
+
+All strategies compute the same math (softmax(QK^T/sqrt(d))V) — tested
+equivalent in tests/test_sequence_parallel.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel.sequence import ring_attention, ulysses_attention
+
+__all__ = ["dot_product_attention", "MultiHeadAttention"]
+
+
+def dot_product_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None):
+    """Plain full attention: q,k,v ``[B, S, H, D]`` -> ``[B, S, H, D]``."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        n = s.shape[-1]
+        mask = jnp.arange(n)[:, None] >= jnp.arange(n)[None, :]
+        s = jnp.where(mask[None, None], s, float("-inf"))
+    p = jnp.asarray(nn.softmax(s, axis=-1))
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    """QKV-projected MHA whose inner attention can be sequence-parallel.
+
+    Attributes:
+      num_heads: attention heads (embed dim must divide evenly).
+      seq_axis: mesh axis name the sequence dim is sharded over, or None.
+        When set, this module MUST be applied inside ``shard_map`` with that
+        axis in scope and inputs sharded ``[B, S/n, ...]``.
+      seq_impl: "ring" or "ulysses" (ignored when ``seq_axis`` is None).
+      dtype: compute dtype (bf16 for mixed precision); fp32 accumulation
+        happens inside the attention strategies regardless.
+    """
+
+    num_heads: int
+    causal: bool = False
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, dim = x.shape
+        if dim % self.num_heads != 0:
+            raise ValueError(f"embed dim {dim} not divisible by {self.num_heads} heads")
+        head_dim = dim // self.num_heads
+        qkv = nn.Dense(3 * dim, dtype=self.dtype, name="qkv")(x)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.seq_axis is None:
+            out = dot_product_attention(q, k, v, causal=self.causal)
+        elif self.seq_impl == "ring":
+            out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        elif self.seq_impl == "ulysses":
+            out = ulysses_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        else:
+            raise ValueError(f"unknown seq_impl {self.seq_impl!r}")
+        out = out.reshape(b, s, dim)
+        return nn.Dense(dim, dtype=self.dtype, name="proj")(out)

@@ -21,6 +21,7 @@ __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",
+    "make_sp_mesh",
     "batch_sharding",
     "batch_pspec",
     "replicated_sharding",
@@ -28,6 +29,31 @@ __all__ = [
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+
+
+def _make_2d_mesh(
+    second_axis_size: int,
+    second_axis_name: str,
+    devices: Optional[Sequence],
+) -> Mesh:
+    """Shared builder for ``(data, <axis>)`` meshes.
+
+    ``mesh_utils.create_device_mesh`` orders the full device set for ICI
+    adjacency; explicit device subsets fall back to a plain reshape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % second_axis_size != 0:
+        raise ValueError(
+            f"{n} devices not divisible by {second_axis_name} size {second_axis_size}"
+        )
+    shape = (n // second_axis_size, second_axis_size)
+    if n == jax.device_count() and list(devices) == jax.devices():
+        dev_array = mesh_utils.create_device_mesh(shape)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, (DATA_AXIS, second_axis_name))
 
 
 def make_mesh(devices: Optional[Sequence] = None, model_parallelism: int = 1) -> Mesh:
@@ -39,19 +65,21 @@ def make_mesh(devices: Optional[Sequence] = None, model_parallelism: int = 1) ->
       model_parallelism: size of the model axis (1 = pure DP, the reference's
         only strategy).
     """
-    if devices is None:
-        devices = jax.devices()
-    n = len(devices)
-    if n % model_parallelism != 0:
-        raise ValueError(
-            f"{n} devices not divisible by model_parallelism={model_parallelism}"
-        )
-    shape = (n // model_parallelism, model_parallelism)
-    if len(devices) == jax.device_count() and devices == jax.devices():
-        dev_array = mesh_utils.create_device_mesh(shape)
-    else:
-        dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, (DATA_AXIS, MODEL_AXIS))
+    return _make_2d_mesh(model_parallelism, MODEL_AXIS, devices)
+
+
+def make_sp_mesh(
+    sequence_parallelism: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """Build a 2-D ``(data, sequence)`` mesh for long-context training.
+
+    The sequence axis carries the ring-attention K/V rotation
+    (:mod:`.sequence`); ``mesh_utils`` ordering keeps ring neighbors
+    ICI-adjacent so the per-step ``ppermute`` is a nearest-neighbor DMA.
+    """
+    from .sequence import SEQUENCE_AXIS
+
+    return _make_2d_mesh(sequence_parallelism, SEQUENCE_AXIS, devices)
 
 
 def batch_pspec(ndim: int) -> P:

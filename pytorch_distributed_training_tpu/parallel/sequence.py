@@ -34,7 +34,7 @@ __all__ = ["ring_attention", "ulysses_attention", "SEQUENCE_AXIS"]
 
 SEQUENCE_AXIS = "sequence"
 
-from ..utils.vma import mark_varying
+from ..utils.vma import mark_varying, varying_axes_of
 
 _NEG_INF = float("-inf")
 
@@ -97,7 +97,7 @@ def ring_attention(
     o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
     # constants start device-invariant; the loop body makes them vary over
     # the ring axis, so the carry types only match if we pre-mark them
-    m0, l0, o0 = mark_varying((m0, l0, o0), (axis_name,))
+    m0, l0, o0 = mark_varying((m0, l0, o0), varying_axes_of(q, (axis_name,)))
     # receive from the right neighbor: after i rotations we hold block idx+i
     perm = [(j, (j - 1) % n) for j in range(n)]
 

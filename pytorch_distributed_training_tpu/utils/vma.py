@@ -12,7 +12,7 @@ from typing import Sequence
 
 import jax
 
-__all__ = ["mark_varying"]
+__all__ = ["mark_varying", "varying_axes_of"]
 
 
 def mark_varying(tree, axis_names: Sequence[str]):
@@ -24,8 +24,16 @@ def mark_varying(tree, axis_names: Sequence[str]):
     axes = tuple(axis_names)
     if not axes:
         return tree
-    if hasattr(jax.lax, "pvary"):
-        return jax.tree.map(lambda x: jax.lax.pvary(x, axes), tree)
     if hasattr(jax.lax, "pcast"):
         return jax.tree.map(lambda x: jax.lax.pcast(x, axes, to="varying"), tree)
+    if hasattr(jax.lax, "pvary"):  # pre-pcast JAX
+        return jax.tree.map(lambda x: jax.lax.pvary(x, axes), tree)
     return tree
+
+
+def varying_axes_of(x, default=()):
+    """The mesh axes ``x`` is varying over (empty outside shard_map)."""
+    try:
+        return tuple(sorted(jax.typeof(x).vma))
+    except (AttributeError, TypeError):
+        return tuple(default)

@@ -92,6 +92,50 @@ def test_sync_bn_stats_update_in_train_step():
     assert any(jax.tree.leaves(changed))
 
 
+def test_dp_step_matches_single_device():
+    """8-device DP + SyncBN step == single-device full-batch step.
+
+    The DDP-parity oracle: gradient averaging, SyncBN statistics, and the
+    SGD update must all compose to exactly the single-device result.  In
+    particular this pins the gradient scale — shard_map's AD transpose
+    already psums the replicated params' cotangent, so an extra post-grad
+    pmean/psum would make grads world_size x too large (caught here).
+    """
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.01, [1000], 0.1)
+    model = get_model("ResNet18", num_classes=8, axis_name=DATA_AXIS)
+    state0 = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    rng = np.random.default_rng(7)
+    img = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    label = rng.integers(0, 8, (16,)).astype(np.int32)
+
+    mesh8 = make_mesh()
+    step8 = build_train_step(model, opt, lr_fn, mesh8, sync_bn=True, donate=False)
+    s8 = jax.device_put(state0, replicated_sharding(mesh8))
+    s8, loss8 = step8(
+        s8,
+        jax.device_put(img, batch_sharding(mesh8, 4)),
+        jax.device_put(label, batch_sharding(mesh8, 1)),
+    )
+
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    step1 = build_train_step(model, opt, lr_fn, mesh1, sync_bn=True, donate=False)
+    s1 = jax.device_put(state0, replicated_sharding(mesh1))
+    s1, loss1 = step1(
+        s1,
+        jax.device_put(img, batch_sharding(mesh1, 4)),
+        jax.device_put(label, batch_sharding(mesh1, 1)),
+    )
+
+    assert np.isclose(float(loss8), float(loss1), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(s8.params), jax.tree.leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+    for a, b in zip(jax.tree.leaves(s8.batch_stats), jax.tree.leaves(s1.batch_stats)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
 def test_eval_step_metrics_sane():
     mesh, state, train_step, eval_step = _tiny_setup(sync_bn=True)
     rng = np.random.default_rng(3)

@@ -16,6 +16,10 @@ TORCHVISION_PARAM_COUNTS = {
     "ResNet152": 60_192_808,
 }
 
+# ViT family added beyond the reference; ViT-B16 matches torchvision
+# vit_b_16 (86.6M @ 1000 classes).
+VIT_NAMES = {"ViT-Ti16", "ViT-S16", "ViT-B16"}
+
 
 def _count(tree):
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
@@ -31,12 +35,21 @@ def test_param_count_parity(name):
 
 
 def test_all_names_resolve():
-    assert set(list_models()) == set(TORCHVISION_PARAM_COUNTS)
+    assert set(list_models()) == set(TORCHVISION_PARAM_COUNTS) | VIT_NAMES
     for name in list_models():
         get_model(name, num_classes=10)
     get_model("resnet50", num_classes=10)  # case-insensitive
     with pytest.raises(KeyError):
         get_model("VGG16", num_classes=10)
+
+
+def test_vit_b16_param_count_parity():
+    model = get_model("ViT-B16", num_classes=1000)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3)), train=False)
+    )
+    # torchvision vit_b_16 @ 1000 classes
+    assert _count(variables["params"]) == 86_567_656
 
 
 def test_forward_shapes_and_stages():
