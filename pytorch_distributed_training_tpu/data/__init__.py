@@ -13,6 +13,7 @@ from .datasets import (
     get_dataset,
 )
 from .loader import DataLoader
+from .prefetch import device_prefetch
 from .sampler import DistributedShardSampler, RandomSampler, SequentialSampler
 
 __all__ = [
@@ -20,6 +21,7 @@ __all__ = [
     "SyntheticDataset",
     "ImageFolderDataset",
     "DataLoader",
+    "device_prefetch",
     "DistributedShardSampler",
     "RandomSampler",
     "SequentialSampler",

@@ -1,0 +1,45 @@
+"""Device-side input double-buffering.
+
+The reference overlaps host->device copies with compute via pinned memory +
+``non_blocking=True`` (train_distributed.py:272-273, SURVEY.md §2.3).  The
+TPU-native equivalent: keep ``depth`` batches' device transfers dispatched
+ahead of the consumer.  JAX transfers are asynchronous — building the global
+array (``jax.make_array_from_process_local_data``) enqueues the H2D copies
+and returns — so holding a small deque of in-flight device batches hides the
+staging latency behind the previous steps' compute.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Iterator, Tuple
+
+__all__ = ["device_prefetch"]
+
+
+def device_prefetch(
+    host_iter: Iterator[Tuple],
+    put: Callable[..., Tuple],
+    depth: int = 2,
+) -> Iterator[Tuple]:
+    """Yield ``put(*batch)`` results with ``depth`` transfers in flight.
+
+    Args:
+      host_iter: iterator of host batches (tuples of numpy arrays).
+      put: dispatches one host batch to the devices (e.g. the engine's
+        sharded ``device_put``); must be non-blocking (JAX's is).
+      depth: in-flight transfer count (2 = classic double buffering).
+    """
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    buf: deque = deque()
+    try:
+        while len(buf) < depth:
+            buf.append(put(*next(host_iter)))
+    except StopIteration:
+        pass
+    while buf:
+        try:
+            buf.append(put(*next(host_iter)))
+        except StopIteration:
+            pass
+        yield buf.popleft()

@@ -7,6 +7,7 @@ from .profiling import TraceProfiler
 from .runner import Runner
 from .sp_steps import build_lm_train_step
 from .steps import TrainState, build_eval_step, build_train_step, init_train_state
+from .tp_steps import build_tp_lm_train_step
 
 __all__ = [
     "Runner",
@@ -15,5 +16,6 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "build_lm_train_step",
+    "build_tp_lm_train_step",
     "init_train_state",
 ]

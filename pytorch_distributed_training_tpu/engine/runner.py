@@ -44,6 +44,7 @@ from ..data import (
     DistributedShardSampler,
     RandomSampler,
     SequentialSampler,
+    device_prefetch,
     get_dataset,
 )
 from ..metrics import AverageMeter
@@ -282,12 +283,18 @@ class Runner:
             else None
         )
 
-        iter_generator = make_iter_dataloader(train_loader, start_iter=self.iter)
+        # device-side double buffering: the next batch's H2D transfer is
+        # dispatched while the current step computes (the reference's pinned
+        # memory + non_blocking copies, :272-273)
+        iter_generator = device_prefetch(
+            make_iter_dataloader(train_loader, start_iter=self.iter),
+            self._put_batch,
+        )
 
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
-            img, label = next(iter_generator)
-            self.train_iter(img, label)
+            g_img, g_label = next(iter_generator)
+            self.train_iter(g_img, g_label)
             if self.profiler:
                 self.profiler.after_step(self.iter, sync=self.state)
 
@@ -330,9 +337,9 @@ class Runner:
         g_label = jax.make_array_from_process_local_data(self._label_sharding, label)
         return g_img, g_label
 
-    def train_iter(self, img, label):
+    def train_iter(self, g_img, g_label):
+        """One training iteration on already-device-resident arrays."""
         train_cfg = self.global_cfg["training"]
-        g_img, g_label = self._put_batch(img, label)
         self.state, loss = self.train_step(self.state, g_img, g_label)
         self._tput_iters += 1
 

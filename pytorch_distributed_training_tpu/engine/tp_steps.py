@@ -1,0 +1,77 @@
+"""Compiled tensor-parallel (DP x TP) LM training step via GSPMD.
+
+Unlike the shard_map-based DP/SP steps (steps.py / sp_steps.py), this step
+is written as straight-line single-device math and parallelized entirely by
+sharding annotations: params carry the Megatron-style ``model``-axis specs
+from :mod:`..parallel.tensor`, the batch is sharded over ``data``, and the
+XLA SPMD partitioner inserts every collective (gradient all-reduce over
+data, partial-sum all-reduce after the row-parallel matmuls, resharding at
+boundaries).  This is the scaling-book recipe verbatim: pick a mesh,
+annotate, let XLA do the communication scheduling.
+
+The same :class:`TransformerLM` module (seq_axis=None) is used — TP here
+composes with DP; combining TP with ring-attention SP on a 3-axis mesh is a
+follow-up that slots into the same builder.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import cross_entropy_loss
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.tensor import tp_state_shardings
+from .steps import TrainState
+
+__all__ = ["build_tp_lm_train_step"]
+
+
+def build_tp_lm_train_step(
+    model,
+    optimizer,
+    lr_fn: Callable,
+    mesh: Mesh,
+    donate: bool = True,
+):
+    """Compile one DP x TP LM iteration (GSPMD-partitioned).
+
+    ``model`` must be a :class:`TransformerLM` with ``seq_axis=None`` (the
+    partitioner, not the module, distributes the math).  Use
+    :func:`..parallel.tensor.tp_state_shardings` to place the state before
+    the first call; in/out shardings are pinned so XLA keeps params resident
+    in their TP layout across steps.
+    """
+
+    def step(state: TrainState, tokens, labels):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            vocab = logits.shape[-1]
+            return cross_entropy_loss(logits.reshape(-1, vocab), labels.reshape(-1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        lr = lr_fn(state.opt_state.step)
+        new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
+        return (
+            TrainState(
+                params=new_params, batch_stats=state.batch_stats, opt_state=new_opt
+            ),
+            loss,
+        )
+
+    def compile_for(state: TrainState):
+        """jit with shardings derived from this state's structure."""
+        state_sh = tp_state_shardings(state, mesh)
+        tok_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        rep = NamedSharding(mesh, P())
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, tok_sh, tok_sh),
+            out_shardings=(state_sh, rep),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    return compile_for

@@ -71,8 +71,13 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(f"embed dim {dim} not divisible by {self.num_heads} heads")
         head_dim = dim // self.num_heads
         qkv = nn.Dense(3 * dim, dtype=self.dtype, name="qkv")(x)
-        qkv = qkv.reshape(b, s, 3, self.num_heads, head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # heads-major layout: the flat 3*dim output factors as (H, 3, hd), so
+        # sharding the qkv kernel's output axis over a model mesh axis (k | H)
+        # splits on whole-head boundaries and GSPMD propagates it through this
+        # reshape — Megatron-style head-parallel attention with no manual
+        # collectives (see parallel.tensor)
+        qkv = qkv.reshape(b, s, self.num_heads, 3, head_dim)
+        q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         if self.seq_axis is None:
             out = dot_product_attention(q, k, v, causal=self.causal)
         elif self.seq_impl == "ring":

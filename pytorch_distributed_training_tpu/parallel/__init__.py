@@ -19,6 +19,7 @@ from .mesh import (
     replicated_sharding,
 )
 from .sequence import SEQUENCE_AXIS, ring_attention, ulysses_attention
+from .tensor import lm_tp_param_specs, lm_tp_shardings, tp_state_shardings
 
 __all__ = [
     "initialize_distributed",

@@ -1,0 +1,77 @@
+"""Tensor parallelism: GSPMD sharding rules for the transformer family.
+
+The third parallelism axis (after data and sequence), done the idiomatic
+XLA way — NOT hand-written collectives: parameters get ``NamedSharding``
+annotations over the mesh ``model`` axis and the XLA SPMD partitioner
+derives the Megatron pattern itself (column-parallel QKV/fc1, head-local
+attention, row-parallel proj/fc2 with an automatic partial-sum all-reduce).
+The reference has no model sharding at all (whole-model replication,
+train_distributed.py:189,198; SURVEY.md §2.4 keeps the axis open).
+
+Rules (kernel shapes are [in, out]):
+
+  ===============================  ======================  =================
+  parameter                        spec                    role
+  ===============================  ======================  =================
+  ``attn/qkv``   kernel / bias     P(None, model) / P(m)   column (heads)
+  ``attn/proj``  kernel            P(model, None)          row (+allreduce)
+  ``mlp/fc1``    kernel / bias     P(None, model) / P(m)   column
+  ``mlp/fc2``    kernel            P(model, None)          row (+allreduce)
+  everything else                  P()                     replicated
+  ===============================  ======================  =================
+
+The QKV column split lands on whole-head boundaries because the attention
+op lays its projection out heads-major (ops/attention.py), so the split
+propagates through the reshape without resharding.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import MODEL_AXIS
+
+__all__ = ["lm_tp_param_specs", "lm_tp_shardings", "tp_state_shardings"]
+
+
+def _spec_for(path) -> P:
+    keys = [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
+    leaf = keys[-1] if keys else ""
+    if "attn" in keys:
+        if "qkv" in keys:
+            return P(None, MODEL_AXIS) if leaf == "kernel" else P(MODEL_AXIS)
+        if "proj" in keys and leaf == "kernel":
+            return P(MODEL_AXIS, None)
+    if "mlp" in keys:
+        if "fc1" in keys:
+            return P(None, MODEL_AXIS) if leaf == "kernel" else P(MODEL_AXIS)
+        if "fc2" in keys and leaf == "kernel":
+            return P(MODEL_AXIS, None)
+    return P()
+
+
+def lm_tp_param_specs(params):
+    """PartitionSpec pytree for a transformer params tree (rules above)."""
+    return jax.tree_util.tree_map_with_path(lambda p, _: _spec_for(p), params)
+
+
+def lm_tp_shardings(params, mesh: Mesh):
+    """NamedSharding pytree for ``params`` on ``mesh``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: NamedSharding(mesh, _spec_for(p)), params
+    )
+
+
+def tp_state_shardings(state, mesh: Mesh):
+    """Shardings for a ``TrainState``: momentum mirrors its parameter."""
+    from ..engine.steps import TrainState  # avoid import cycle at module load
+
+    assert isinstance(state, TrainState)
+    param_sh = lm_tp_shardings(state.params, mesh)
+    rep = NamedSharding(mesh, P())
+    opt_sh = type(state.opt_state)(
+        momentum=lm_tp_shardings(state.opt_state.momentum, mesh),
+        step=rep,
+    )
+    bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
+    return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)

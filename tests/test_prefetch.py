@@ -1,0 +1,38 @@
+"""Device-prefetch iterator: ordering, depth, exhaustion."""
+import pytest
+
+from pytorch_distributed_training_tpu.data import device_prefetch
+
+
+def test_order_preserved_and_all_yielded():
+    src = iter([(i,) for i in range(7)])
+    calls = []
+
+    def put(x):
+        calls.append(x)
+        return ("dev", x)
+
+    out = list(device_prefetch(src, put, depth=2))
+    assert out == [("dev", i) for i in range(7)]
+    assert calls == list(range(7))
+
+
+def test_put_runs_ahead_of_consumption():
+    src = iter([(i,) for i in range(5)])
+    staged = []
+    gen = device_prefetch(src, lambda x: staged.append(x) or x, depth=3)
+    first = next(gen)
+    assert first == 0
+    # with depth=3, transfers for 0,1,2 were dispatched before the first
+    # yield, and yielding one triggers dispatch of the next
+    assert staged == [0, 1, 2, 3]
+
+
+def test_short_stream_and_empty():
+    assert list(device_prefetch(iter([(1,), (2,)]), lambda x: x, depth=4)) == [1, 2]
+    assert list(device_prefetch(iter([]), lambda x: x, depth=2)) == []
+
+
+def test_bad_depth():
+    with pytest.raises(ValueError):
+        list(device_prefetch(iter([]), lambda x: x, depth=0))
