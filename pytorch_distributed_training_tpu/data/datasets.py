@@ -30,6 +30,9 @@ import numpy as np
 
 __all__ = [
     "get_dataset",
+    "fetch_sample",
+    "sample_rng",
+    "sample_crop_params",
     "SyntheticDataset",
     "ImageFolderDataset",
     "IMAGENET_MEAN",
@@ -83,12 +86,94 @@ class SyntheticDataset:
         return img, np.int64(label)
 
 
+def sample_rng(seed: int, epoch: int, idx: int) -> np.random.Generator:
+    """Per-sample augmentation RNG: ``default_rng([seed, epoch, idx])``.
+
+    numpy's ``SeedSequence`` mixes the triple, so every (seed, epoch, sample)
+    gets an independent, *reproducible* stream — augmentation no longer
+    depends on thread/process scheduling or on a shared global RNG, and
+    different samples get different crop/flip draws even though every host
+    seeds identically (reference train_distributed.py:141-142).
+    """
+    return np.random.default_rng([int(seed) & 0xFFFFFFFF, int(epoch), int(idx)])
+
+
+def fetch_sample(dataset, idx: int, seed: int, epoch: int):
+    """Fetch ``dataset[idx]`` with an explicit per-sample augmentation RNG.
+
+    Datasets exposing ``get_sample(idx, rng)`` (stochastic augmentation) get
+    the deterministic per-sample stream; plain ``__getitem__`` datasets
+    (index-seeded, e.g. :class:`SyntheticDataset`) are called directly.
+    """
+    get = getattr(dataset, "get_sample", None)
+    if get is not None:
+        return get(idx, sample_rng(seed, epoch, idx))
+    return dataset[int(idx)]
+
+
+def sample_crop_params(
+    w: int,
+    h: int,
+    rng: Optional[np.random.Generator],
+    train: bool,
+    scale=(0.08, 1.0),
+    ratio=(3 / 4, 4 / 3),
+    resize_to: int = 256,
+    size: int = 224,
+) -> Tuple[float, float, float, float, bool]:
+    """Sample the source crop box ``(x, y, cw, ch)`` + horizontal-flip flag.
+
+    Train: torchvision ``RandomResizedCrop`` semantics — 10 attempts at an
+    area/aspect-jittered box, center-crop fallback — plus a p=0.5 flip.
+    Val (``train=False``): the deterministic Resize(``resize_to``) +
+    CenterCrop(``size``) pipeline expressed as one equivalent source box
+    (``size/scale`` pixels centered after shorter-side scaling), so both the
+    PIL path and the native decode kernel resample the original image exactly
+    once.  Separating parameter *sampling* (host RNG, here) from pixel work
+    (PIL or the native C++ kernel) keeps augmentation bit-reproducible no
+    matter which backend executes the pixels.
+    """
+    if train:
+        assert rng is not None, "train crop sampling requires an RNG"
+        area = w * h
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        for _ in range(10):
+            target_area = area * rng.uniform(*scale)
+            aspect = np.exp(rng.uniform(*log_ratio))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                x = int(rng.integers(0, w - cw + 1))
+                y = int(rng.integers(0, h - ch + 1))
+                return float(x), float(y), float(cw), float(ch), bool(rng.random() < 0.5)
+        # fallback: central crop at clamped aspect (torchvision semantics)
+        in_ratio = w / h
+        if in_ratio < ratio[0]:
+            cw, ch = w, int(round(w / ratio[0]))
+        elif in_ratio > ratio[1]:
+            cw, ch = int(round(h * ratio[1])), h
+        else:
+            cw, ch = w, h
+        x, y = (w - cw) // 2, (h - ch) // 2
+        return float(x), float(y), float(cw), float(ch), bool(rng.random() < 0.5)
+    # val: shorter side -> resize_to, center size x size
+    s = resize_to / min(w, h)
+    cw = size / s
+    ch = size / s
+    x = (w - cw) / 2
+    y = (h - ch) / 2
+    return x, y, cw, ch, False
+
+
 class ImageFolderDataset:
     """``<root>/<split>/<class_dir>/<image>`` layout, torchvision semantics.
 
     Class indices are assigned by sorted class-dir name (torchvision
     ``ImageFolder`` parity — required for val accuracy comparability).
-    Decoding uses PIL; transforms follow the standard ImageNet recipe.
+    Crop/flip parameters are sampled on the host (``sample_crop_params``);
+    pixel work (decode, crop, resize, flip) runs in PIL here, or — the hot
+    path — in the native C++ batch kernel (``native.decode_jpeg_batch``),
+    which the loader uses for whole batches when every sample is a JPEG.
     """
 
     def __init__(self, root: str, split: str, image_size: int = 224, train_transform: Optional[bool] = None):
@@ -120,53 +205,66 @@ class ImageFolderDataset:
     norm_mean = IMAGENET_MEAN
     norm_std = IMAGENET_STD
 
-    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
+    def image_dims(self, idx: int) -> Tuple[int, int]:
+        """(width, height) from the image header only — no pixel decode
+        (PIL ``open`` is lazy), so crop-box sampling for the native batch
+        path costs microseconds per sample."""
+        from PIL import Image
+
+        with Image.open(self.samples[idx][0]) as im:
+            return im.size
+
+    def crop_task(self, idx: int, rng: Optional[np.random.Generator]):
+        """(path, label, crop box+flip) for the native batch decode path."""
+        path, label = self.samples[idx]
+        w, h = self.image_dims(idx)
+        params = sample_crop_params(w, h, rng, self.train, size=self.image_size)
+        return path, label, params
+
+    def _pil_pixels(self, im, params) -> np.ndarray:
+        """Crop/resize/flip an open PIL image with already-sampled params."""
+        from PIL import Image
+
+        x, y, cw, ch, flip = params
+        im = im.convert("RGB")
+        im = im.resize(
+            (self.image_size, self.image_size),
+            Image.BILINEAR,
+            box=(x, y, x + cw, y + ch),
+        )
+        if flip:
+            im = im.transpose(Image.FLIP_LEFT_RIGHT)
+        # uint8 here; the /255-mean/std normalization is fused into the
+        # native batch-assembly pass (one pass, no per-image temporaries)
+        return np.asarray(im, dtype=np.uint8)
+
+    def decode_with_params(self, idx: int, params) -> np.ndarray:
+        """PIL pixel path for an already-sampled crop box + flip flag.
+
+        Used directly by the loader when the native kernel reports a row it
+        cannot decode (non-JPEG, CMYK) — the *same* params the native path
+        would have used, so fallback rows stay bit-reproducible.
+        """
+        from PIL import Image
+
+        with Image.open(self.samples[idx][0]) as im:
+            return self._pil_pixels(im, params)
+
+    def get_sample(self, idx: int, rng: Optional[np.random.Generator]) -> Tuple[np.ndarray, np.int64]:
+        """PIL reference path: one open — header dims, param sampling, then
+        decode + one-shot box resize (+flip)."""
         from PIL import Image
 
         path, label = self.samples[idx]
         with Image.open(path) as im:
-            im = im.convert("RGB")
-            if self.train:
-                im = _random_resized_crop(im, self.image_size)
-                if np.random.random() < 0.5:
-                    im = im.transpose(Image.FLIP_LEFT_RIGHT)
-            else:
-                im = _resize_center_crop(im, self.image_size)
-            # uint8 here; the /255-mean/std normalization is fused into the
-            # native batch-assembly pass (one pass, no per-image temporaries)
-            arr = np.asarray(im, dtype=np.uint8)
-        return arr, np.int64(label)
+            w, h = im.size
+            params = sample_crop_params(w, h, rng, self.train, size=self.image_size)
+            return self._pil_pixels(im, params), np.int64(label)
 
-
-def _random_resized_crop(im, size: int, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
-    """torchvision RandomResizedCrop semantics (10 attempts then center fallback)."""
-    from PIL import Image
-
-    w, h = im.size
-    area = w * h
-    for _ in range(10):
-        target_area = area * np.random.uniform(*scale)
-        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
-        aspect = np.exp(np.random.uniform(*log_ratio))
-        cw = int(round(np.sqrt(target_area * aspect)))
-        ch = int(round(np.sqrt(target_area / aspect)))
-        if 0 < cw <= w and 0 < ch <= h:
-            x = np.random.randint(0, w - cw + 1)
-            y = np.random.randint(0, h - ch + 1)
-            return im.resize((size, size), Image.BILINEAR, box=(x, y, x + cw, y + ch))
-    return _resize_center_crop(im, size)
-
-
-def _resize_center_crop(im, size: int, resize_to: int = 256):
-    from PIL import Image
-
-    w, h = im.size
-    scale = resize_to / min(w, h)
-    im = im.resize((max(1, round(w * scale)), max(1, round(h * scale))), Image.BILINEAR)
-    w, h = im.size
-    x = (w - size) // 2
-    y = (h - size) // 2
-    return im.crop((x, y, x + size, y + size))
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
+        # Index-seeded fallback (epoch-0 stream); loaders use fetch_sample /
+        # crop_task with the (seed, epoch, idx) stream instead.
+        return self.get_sample(idx, sample_rng(0, 0, idx))
 
 
 def get_dataset(

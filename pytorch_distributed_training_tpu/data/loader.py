@@ -1,14 +1,28 @@
-"""Host-side batched loader with threaded decode + prefetch.
+"""Host-side batched loader: native batch decode, thread, or process workers.
 
 The TPU-native replacement for ``torch.utils.data.DataLoader`` with worker
 processes and pinned memory (reference: train_distributed.py:227-241,
-SURVEY.md §2.3): JAX keeps one controller process per host, so parallel
-decode/augment runs in a thread pool (PIL decode and numpy augment release
-the GIL for the heavy parts) and batches are prefetched into a bounded queue
-so host I/O overlaps device compute — the role pinned memory + ``non_blocking``
-H2D copies play in the reference (:272-273).  Device placement itself happens
-in the engine (``jax.device_put`` with the batch sharding), double-buffered
-by this queue.
+SURVEY.md §2.3).  JAX keeps one controller process per host, so the loader
+offers three assembly backends, selected by ``worker_mode``:
+
+  - ``"native"`` (auto-picked for JPEG folder datasets): crop/flip params are
+    sampled per-sample on the host (counter-based RNG streams — reproducible
+    regardless of scheduling), then ONE call into the native C++ kernel
+    (native/decode.cpp) decodes, crops, antialias-resizes, flips and
+    normalizes the whole batch on an internal thread pool with the GIL
+    released — the torch-worker-pool capability without processes.
+  - ``"process"``: N spawned worker processes assemble batches into a
+    shared-memory slot ring (worker_pool.py) — the generic GIL-free path for
+    pure-Python datasets.
+  - ``"thread"``: in-process thread pool; right for datasets whose
+    ``__getitem__`` releases the GIL (numpy-heavy synthetic data) and for
+    tiny smoke runs.
+
+Every backend prefetches assembled batches through a bounded queue so host
+work overlaps device compute — the role pinned memory + ``non_blocking`` H2D
+copies play in the reference (:272-273); device placement happens in the
+engine (``jax.device_put`` with the batch sharding), double-buffered by
+``data.prefetch.device_prefetch``.
 
 Batch-shape policy (XLA static shapes — SURVEY.md §7 design stance):
   - ``drop_last=True`` (train): only full batches are yielded; with the
@@ -30,9 +44,12 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from .datasets import fetch_sample, sample_rng
 from .sampler import DistributedShardSampler
 
 __all__ = ["DataLoader"]
+
+_MODES = ("auto", "native", "thread", "process")
 
 
 class DataLoader:
@@ -44,13 +61,31 @@ class DataLoader:
         num_workers: int = 0,
         drop_last: bool = False,
         prefetch_batches: int = 2,
+        worker_mode: str = "auto",
+        dct_denom: int = 1,
     ):
+        if worker_mode not in _MODES:
+            raise ValueError(f"worker_mode must be one of {_MODES}, got {worker_mode!r}")
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.sampler = sampler
         self.num_workers = int(num_workers)
         self.drop_last = bool(drop_last)
         self.prefetch_batches = max(1, int(prefetch_batches))
+        self.dct_denom = int(dct_denom)
+        self.seed = int(getattr(sampler, "seed", 0))
+        self._pool = None  # lazily-created ProcessLoaderPool
+        self.worker_mode = self._resolve_mode(worker_mode)
+
+    def _resolve_mode(self, mode: str) -> str:
+        if mode != "auto":
+            return mode
+        if hasattr(self.dataset, "crop_task"):
+            from ..native import native_available
+
+            if native_available():
+                return "native"
+        return "thread"
 
     def set_epoch(self, epoch: int) -> None:
         self.sampler.set_epoch(epoch)
@@ -60,6 +95,12 @@ class DataLoader:
         index-level fast-forward (no decode cost) used by checkpoint resume
         to re-align the data stream with the restored iteration counter."""
         self._skip_next = int(n_batches)
+
+    def close(self) -> None:
+        """Shut down persistent worker processes (no-op for other modes)."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
 
     def _batch_indices(self) -> list:
         idx = self.sampler.local_indices()
@@ -79,27 +120,66 @@ class DataLoader:
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def _assemble(self, indices: np.ndarray, pool: Optional[ThreadPoolExecutor]):
+    # ----------------------------------------------------- batch assembly
+    def _normalize_u8(self, imgs: np.ndarray) -> np.ndarray:
+        """Fused uint8 -> normalized float32 (native kernel, numpy fallback)."""
+        from ..native import normalize_batch
+
+        mean = getattr(self.dataset, "norm_mean", None)
+        std = getattr(self.dataset, "norm_std", None)
+        if mean is not None and std is not None:
+            return normalize_batch(imgs, mean, std)
+        return imgs.astype(np.float32) / 255.0
+
+    def _assemble(
+        self, indices: np.ndarray, epoch: int, pool: Optional[ThreadPoolExecutor]
+    ):
+        """Thread/sync path: per-sample Python fetch + batch normalize."""
+        fetch = lambda i: fetch_sample(self.dataset, int(i), self.seed, epoch)  # noqa: E731
         if pool is not None:
-            samples = list(pool.map(self.dataset.__getitem__, indices))
+            samples = list(pool.map(fetch, indices))
         else:
-            samples = [self.dataset[i] for i in indices]
+            samples = [fetch(i) for i in indices]
         imgs = np.stack([s[0] for s in samples])
         if imgs.dtype == np.uint8:
-            # fused uint8 -> normalized float32 (native C++ kernel, threaded;
-            # numpy fallback inside) — the pinned-memory/worker-pool stage of
-            # the reference's DataLoader, done once per batch
-            from ..native import normalize_batch
-
-            mean = getattr(self.dataset, "norm_mean", None)
-            std = getattr(self.dataset, "norm_std", None)
-            if mean is not None and std is not None:
-                imgs = normalize_batch(imgs, mean, std)
-            else:
-                imgs = imgs.astype(np.float32) / 255.0
+            imgs = self._normalize_u8(imgs)
         labels = np.asarray([s[1] for s in samples], dtype=np.int64)
         return imgs, labels
 
+    def _assemble_native(self, indices: np.ndarray, epoch: int):
+        """Native path: sample params on host, decode the batch in C++."""
+        from ..native import decode_jpeg_batch
+
+        ds = self.dataset
+        tasks = [
+            ds.crop_task(int(i), sample_rng(self.seed, epoch, int(i)))
+            for i in indices
+        ]
+        paths = [t[0] for t in tasks]
+        labels = np.asarray([t[1] for t in tasks], dtype=np.int64)
+        boxes = np.asarray([t[2][:4] for t in tasks], dtype=np.float64)
+        flips = np.asarray([t[2][4] for t in tasks], dtype=np.uint8)
+        out, status = decode_jpeg_batch(
+            paths,
+            boxes,
+            flips,
+            ds.image_size,
+            ds.norm_mean,
+            ds.norm_std,
+            dct_denom=self.dct_denom,
+            n_threads=self.num_workers if self.num_workers > 0 else 1,
+        )
+        if status.any():
+            # rows libjpeg can't handle (PNG, CMYK, corrupt) -> PIL, with the
+            # SAME already-sampled params, so bytes don't depend on the path
+            from ..native import normalize_batch
+
+            for r in np.nonzero(status)[0]:
+                arr = ds.decode_with_params(int(indices[r]), tasks[r][2])
+                out[r] = normalize_batch(arr[None], ds.norm_mean, ds.norm_std)[0]
+        return out, labels
+
+    # ------------------------------------------------------------ iteration
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         batches = self._batch_indices()
         skip = getattr(self, "_skip_next", 0)
@@ -107,17 +187,55 @@ class DataLoader:
             batches = batches[skip:]
             self._skip_next = 0
         if not batches:
-            return
-        pool = ThreadPoolExecutor(self.num_workers) if self.num_workers > 0 else None
+            return iter(())
+        epoch = int(getattr(self.sampler, "epoch", 0))
+        if self.worker_mode == "process":
+            return self._iter_process(batches, epoch)
+        return self._iter_queued(batches, epoch)
+
+    def _iter_process(self, batches, epoch: int):
+        if self._pool is None:
+            from .worker_pool import ProcessLoaderPool
+
+            probe_img, _ = fetch_sample(
+                self.dataset, int(batches[0][0]), self.seed, epoch
+            )
+            self._pool = ProcessLoaderPool(
+                self.dataset,
+                batch_size=self.batch_size,
+                sample_shape=probe_img.shape,
+                sample_dtype=probe_img.dtype,
+                num_workers=max(1, self.num_workers),
+                seed=self.seed,
+            )
+
+        def postprocess(slot_view: np.ndarray, label_view: np.ndarray):
+            if slot_view.dtype == np.uint8:
+                imgs = self._normalize_u8(slot_view)  # writes a fresh array
+            else:
+                imgs = np.array(slot_view)  # copy out: slot is recycled next
+            return imgs, np.array(label_view)
+
+        return self._pool.run_epoch(batches, epoch, postprocess)
+
+    def _iter_queued(self, batches, epoch: int):
+        """Producer thread assembling batches ahead through a bounded queue."""
+        use_threads = self.worker_mode == "thread" and self.num_workers > 0
+        pool = ThreadPoolExecutor(self.num_workers) if use_threads else None
         out_q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
         stop = threading.Event()
+
+        def assemble(b):
+            if self.worker_mode == "native":
+                return self._assemble_native(b, epoch)
+            return self._assemble(b, epoch, pool)
 
         def producer():
             try:
                 for b in batches:
                     if stop.is_set():
                         return
-                    out_q.put(self._assemble(b, pool))
+                    out_q.put(assemble(b))
                 out_q.put(None)
             except BaseException as e:  # surface worker errors to the consumer
                 out_q.put(e)

@@ -1,0 +1,242 @@
+"""Process-based decode workers with shared-memory batch handoff.
+
+The reference scales host-side decode with DataLoader worker *processes* +
+pinned-memory staging (train_distributed.py:227-241, SURVEY.md §2.3).  The
+TPU rebuild's primary hot path is the native C++ batch decoder (GIL-free by
+construction, native/decode.cpp); this pool is the generic equivalent for
+*Python-side* datasets: N spawned worker processes assemble whole batches
+into a shared-memory slot ring, so pure-Python ``__getitem__`` pipelines
+(PIL fallback, custom datasets) scale across cores exactly the way torch's
+worker processes do.
+
+Design:
+  - ``spawn`` start method (safe alongside an initialized JAX runtime; the
+    workers import only numpy/PIL — never JAX).
+  - One shared-memory slab of ``n_slots`` batch slots (+ a label slab);
+    workers write samples straight into their assigned slot — the handoff
+    queue carries only ``(seq, slot)`` tuples, never pixels.
+  - Batch order is preserved via a reorder buffer keyed by submission
+    sequence number; augmentation determinism is per-sample
+    (``fetch_sample``'s counter-based streams), so *which* worker decodes a
+    batch cannot change its bytes.
+  - A generation counter lets an abandoned epoch iterator drain its
+    in-flight results without poisoning the next epoch.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import queue
+import traceback
+from collections import deque
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import multiprocessing as mp
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from .datasets import fetch_sample
+
+__all__ = ["ProcessLoaderPool"]
+
+
+def _pool_worker_main(
+    dataset,
+    seed: int,
+    shm_name: str,
+    lshm_name: str,
+    n_slots: int,
+    batch_size: int,
+    sample_shape: tuple,
+    sample_dtype: str,
+    task_q,
+    result_q,
+):
+    """Worker loop: fetch per-sample data into the assigned shm slot."""
+    shm = shared_memory.SharedMemory(name=shm_name)
+    lshm = shared_memory.SharedMemory(name=lshm_name)
+    try:
+        slots = np.ndarray(
+            (n_slots, batch_size) + sample_shape,
+            dtype=np.dtype(sample_dtype),
+            buffer=shm.buf,
+        )
+        labels = np.ndarray((n_slots, batch_size), dtype=np.int64, buffer=lshm.buf)
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            gen, seq, slot, epoch, indices = task
+            try:
+                for row, idx in enumerate(indices):
+                    img, lab = fetch_sample(dataset, int(idx), seed, epoch)
+                    slots[slot, row] = img
+                    labels[slot, row] = lab
+                result_q.put((gen, seq, slot, None))
+            except Exception:
+                result_q.put((gen, seq, slot, traceback.format_exc()))
+    finally:
+        shm.close()
+        lshm.close()
+
+
+class ProcessLoaderPool:
+    """Persistent pool of decode worker processes + shm slot ring."""
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        sample_shape: Sequence[int],
+        sample_dtype: np.dtype,
+        num_workers: int,
+        seed: int,
+        n_slots: Optional[int] = None,
+    ):
+        if num_workers < 1:
+            raise ValueError("ProcessLoaderPool requires num_workers >= 1")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.sample_shape = tuple(int(s) for s in sample_shape)
+        self.sample_dtype = np.dtype(sample_dtype)
+        self.num_workers = int(num_workers)
+        # enough slots that every worker can be busy while a couple of
+        # finished batches wait in the reorder buffer
+        self.n_slots = int(n_slots) if n_slots else self.num_workers + 2
+        self.seed = int(seed)
+        self._gen = 0
+        self._stale_outstanding = 0
+        self._closed = False
+
+        slot_bytes = (
+            self.batch_size * int(np.prod(self.sample_shape)) * self.sample_dtype.itemsize
+        )
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=max(1, self.n_slots * slot_bytes)
+        )
+        self._lshm = shared_memory.SharedMemory(
+            create=True, size=self.n_slots * self.batch_size * 8
+        )
+        self._slots = np.ndarray(
+            (self.n_slots, self.batch_size) + self.sample_shape,
+            dtype=self.sample_dtype,
+            buffer=self._shm.buf,
+        )
+        self._labels = np.ndarray(
+            (self.n_slots, self.batch_size), dtype=np.int64, buffer=self._lshm.buf
+        )
+
+        ctx = mp.get_context("spawn")
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(
+                target=_pool_worker_main,
+                args=(
+                    dataset,
+                    self.seed,
+                    self._shm.name,
+                    self._lshm.name,
+                    self.n_slots,
+                    self.batch_size,
+                    self.sample_shape,
+                    self.sample_dtype.str,
+                    self._task_q,
+                    self._result_q,
+                ),
+                daemon=True,
+            )
+            for _ in range(self.num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------------ epoch
+    def run_epoch(
+        self, batches: List[np.ndarray], epoch: int, postprocess
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Stream ``batches`` (index arrays) through the pool in order.
+
+        ``postprocess(slot_view, label_view) -> (imgs, labels)`` converts a
+        filled slot into caller-owned arrays (normalize or copy); the slot is
+        recycled immediately after it returns.
+        """
+        # A previous epoch abandoned mid-flight leaves workers writing into
+        # slots this epoch would otherwise hand out; wait for those stale
+        # tasks to finish before rebuilding the slot ring.
+        while self._stale_outstanding > 0:
+            self._collect_one()
+            self._stale_outstanding -= 1
+        self._gen += 1
+        gen = self._gen
+        pending = deque(enumerate(batches))
+        free = list(range(self.n_slots))
+        inflight = {}  # seq -> slot
+        done = {}  # seq -> slot
+        next_yield = 0
+        try:
+            while next_yield < len(batches):
+                while free and pending:
+                    seq, idxs = pending.popleft()
+                    slot = free.pop()
+                    inflight[seq] = slot
+                    self._task_q.put((gen, seq, slot, int(epoch), np.asarray(idxs)))
+                if next_yield in done:
+                    slot = done.pop(next_yield)
+                    out = postprocess(self._slots[slot], self._labels[slot])
+                    free.append(slot)
+                    next_yield += 1
+                    yield out
+                    continue
+                r = self._collect_one()
+                if r[0] != gen:  # stale result from an abandoned epoch
+                    self._stale_outstanding -= 1
+                    continue
+                _, seq, slot, err = r
+                inflight.pop(seq, None)
+                if err is not None:
+                    raise RuntimeError(f"decode worker failed:\n{err}")
+                done[seq] = slot
+        finally:
+            # Abandoned mid-epoch: record tasks still running so the next
+            # run_epoch drains them before reusing their slots. Completed-
+            # but-unclaimed results (in ``done``) are already off the queue.
+            self._stale_outstanding += len(inflight)
+
+    def _collect_one(self):
+        while True:
+            try:
+                return self._result_q.get(timeout=5.0)
+            except queue.Empty:
+                dead = [p.pid for p in self._procs if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"decode worker process(es) died: pids {dead}"
+                    ) from None
+
+    # ------------------------------------------------------------------ close
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            for _ in self._procs:
+                self._task_q.put(None)
+            for p in self._procs:
+                p.join(timeout=2.0)
+            for p in self._procs:
+                if p.is_alive():
+                    p.terminate()
+        finally:
+            for shm in (self._shm, self._lshm):
+                try:
+                    shm.close()
+                    shm.unlink()
+                except FileNotFoundError:  # pragma: no cover
+                    pass
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # pragma: no cover
+            pass

@@ -213,12 +213,17 @@ class Runner:
             train_sampler = RandomSampler(len(train_dataset), seed=seed)
             val_sampler = SequentialSampler(len(val_dataset))
 
-        train_loader = DataLoader(
+        # Additive key (unknown to the reference schema): loader backend —
+        # "auto" picks the native C++ batch decoder for JPEG folder datasets,
+        # threads otherwise; "process"/"thread" force a backend (loader.py).
+        worker_mode = train_cfg.get("worker_mode", "auto")
+        self.train_loader = train_loader = DataLoader(
             train_dataset,
             batch_size=host_batch,
             sampler=train_sampler,
             num_workers=n_workers,
             drop_last=True,
+            worker_mode=worker_mode,
         )
         # Parity: val loader reuses TRAINING batch/workers (:235-241).
         self.val_loader = DataLoader(
@@ -227,6 +232,7 @@ class Runner:
             sampler=val_sampler,
             num_workers=n_workers,
             drop_last=False,
+            worker_mode=worker_mode,
         )
         self.logger.info(
             "Load dataset done\nTraining: %d imgs, %d batchs\nEval: %d imgs, %d batchs",
@@ -326,6 +332,8 @@ class Runner:
         if self.checkpointer:
             self.checkpointer.wait()
             self.checkpointer.close()
+        self.train_loader.close()
+        self.val_loader.close()
 
     # ------------------------------------------------------------- hot loop
     def _put_batch(self, img: np.ndarray, label: np.ndarray):

@@ -1,0 +1,148 @@
+"""torchvision ResNet checkpoint import.
+
+The reference's accuracy north star is the torchvision ImageNet table
+(/root/reference/README.md:9-13); its models come from a torchvision-weight-
+compatible zoo (``TORCH_HOME`` cache, /root/reference/train.sh:2).  This
+module makes that parity *checkable and usable*: it converts a torchvision
+ResNet ``state_dict`` (18/34/50/101/152) into this framework's Flax
+variables, so
+
+  - users can start from torchvision pretrained weights on TPU, and
+  - the test suite can assert eval-mode logit equality against a torch
+    execution of the same weights — pinning stride placement, padding, BN
+    eps/momentum and classifier layout (tests/test_torch_port.py).
+
+Layout conversions (PyTorch -> Flax/TPU):
+  - conv weights OIHW -> HWIO,
+  - linear weights (out, in) -> (in, out),
+  - BN ``weight``/``bias`` -> params ``scale``/``bias``; ``running_mean``/
+    ``running_var`` -> batch_stats ``mean``/``var``,
+  - ``layer{s}.{b}.`` module names -> ``layer{s}_{b}`` (flat Flax names),
+  - ``downsample.0/.1`` -> ``downsample_conv``/``downsample_bn``.
+
+The conversion is strict in both directions: every torch tensor must be
+consumed and every Flax leaf assigned, so any topology drift fails loudly
+instead of silently zero-filling.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Tuple
+
+import numpy as np
+
+__all__ = ["import_torch_resnet_state_dict", "load_torchvision_checkpoint"]
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch.Tensor without importing torch here
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _torch_key(path: Tuple[str, ...]) -> Tuple[str, str]:
+    """Map a Flax variables path to (torch state_dict key, transform).
+
+    ``path`` is (collection, module..., leaf); returns the torch key plus a
+    transform tag in {"conv", "linear", "none"}.
+    """
+    collection, *mods, leaf = path
+    torch_mods = []
+    for m in mods:
+        if m.startswith("layer") and "_" in m:
+            stage, block = m[len("layer"):].split("_")
+            torch_mods.append(f"layer{stage}.{block}")
+        elif m == "downsample_conv":
+            torch_mods.append("downsample.0")
+        elif m == "downsample_bn":
+            torch_mods.append("downsample.1")
+        else:
+            torch_mods.append(m)
+    prefix = ".".join(torch_mods)
+
+    if collection == "batch_stats":
+        leaf_map = {"mean": "running_mean", "var": "running_var"}
+        return f"{prefix}.{leaf_map[leaf]}", "none"
+
+    # params collection
+    if leaf == "scale":
+        return f"{prefix}.weight", "none"  # BN scale
+    if leaf == "bias":
+        return f"{prefix}.bias", "none"  # BN bias or fc bias (same key shape)
+    if leaf == "kernel":
+        if mods[-1] == "fc":
+            return f"{prefix}.weight", "linear"
+        return f"{prefix}.weight", "conv"
+    raise KeyError(f"unmapped Flax leaf {path}")
+
+
+def _flatten(tree: Mapping, prefix: Tuple[str, ...] = ()) -> Dict[Tuple[str, ...], Any]:
+    out: Dict[Tuple[str, ...], Any] = {}
+    for k, v in tree.items():
+        if isinstance(v, Mapping):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _unflatten(flat: Dict[Tuple[str, ...], Any]) -> Dict:
+    tree: Dict = {}
+    for path, v in flat.items():
+        node = tree
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = v
+    return tree
+
+
+def import_torch_resnet_state_dict(
+    variables: Mapping, state_dict: Mapping[str, Any]
+) -> Dict:
+    """Convert a torchvision ResNet ``state_dict`` into Flax ``variables``.
+
+    Args:
+      variables: the Flax variables pytree from ``model.init`` (template for
+        structure and shapes: ``{"params": ..., "batch_stats": ...}``).
+      state_dict: torchvision-format mapping (torch tensors or numpy arrays).
+
+    Returns a new variables dict with every leaf replaced by the converted
+    torch weight.  Raises ``KeyError``/``ValueError`` on any missing,
+    unconsumed, or shape-mismatched tensor.
+    """
+    flat = _flatten(dict(variables))
+    consumed = set()
+    new_flat: Dict[Tuple[str, ...], Any] = {}
+    for path, leaf in flat.items():
+        key, transform = _torch_key(path)
+        if key not in state_dict:
+            raise KeyError(f"torch state_dict missing '{key}' (for Flax {path})")
+        arr = _to_numpy(state_dict[key])
+        if transform == "conv":
+            arr = np.transpose(arr, (2, 3, 1, 0))  # OIHW -> HWIO
+        elif transform == "linear":
+            arr = arr.T  # (out, in) -> (in, out)
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch for {key}: torch {arr.shape} vs Flax "
+                f"{np.shape(leaf)} at {path}"
+            )
+        new_flat[path] = arr.astype(np.asarray(leaf).dtype)
+        consumed.add(key)
+    leftovers = [
+        k
+        for k in state_dict
+        if k not in consumed and not k.endswith("num_batches_tracked")
+    ]
+    if leftovers:
+        raise KeyError(f"torch state_dict keys not consumed: {leftovers[:8]}")
+    return _unflatten(new_flat)
+
+
+def load_torchvision_checkpoint(path: str, variables: Mapping) -> Dict:
+    """Load a ``.pth`` torchvision ResNet checkpoint into Flax variables."""
+    import torch
+
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    if "state_dict" in state_dict:  # training-harness checkpoints nest it
+        state_dict = state_dict["state_dict"]
+    return import_torch_resnet_state_dict(variables, state_dict)

@@ -16,7 +16,12 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["normalize_batch", "native_available", "ensure_built"]
+__all__ = [
+    "normalize_batch",
+    "decode_jpeg_batch",
+    "native_available",
+    "ensure_built",
+]
 
 _LIB_NAME = "libpdt_native.so"
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
@@ -63,6 +68,20 @@ def ensure_built() -> bool:
                 ctypes.c_int,
             ]
             lib.pdt_normalize_u8_nhwc.restype = None
+            lib.pdt_decode_jpeg_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_long,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.pdt_decode_jpeg_batch.restype = None
             _lib = lib
             return True
         except Exception:
@@ -110,3 +129,65 @@ def normalize_batch(
         )
         return out
     return ((batch_u8.astype(np.float32) / 255.0) - mean) / std
+
+
+def decode_jpeg_batch(
+    paths,
+    boxes: np.ndarray,
+    flips: np.ndarray,
+    out_size: int,
+    mean: np.ndarray,
+    std: np.ndarray,
+    out: Optional[np.ndarray] = None,
+    dct_denom: int = 1,
+    n_threads: int = 0,
+):
+    """Decode a batch of JPEG files into normalized float32 NHWC images.
+
+    The native input-pipeline hot path (native/decode.cpp): per image —
+    libjpeg decode, crop to ``boxes[i]`` (original-image coords), PIL-style
+    antialiased resize to ``out_size``, optional horizontal flip, fused
+    ``(x/255 - mean)/std`` normalization — parallelized over an internal C++
+    thread pool with the GIL released for the whole batch.
+
+    Returns ``(out, status)``: ``status[i] != 0`` marks rows the kernel could
+    not decode (non-JPEG, CMYK, corrupt); callers fall back to the PIL path
+    for those rows.  Raises RuntimeError when the native library is
+    unavailable (callers gate on :func:`native_available`).
+    """
+    if not ensure_built():
+        raise RuntimeError("native library unavailable; use the PIL path")
+    n = len(paths)
+    boxes = np.ascontiguousarray(boxes, dtype=np.float64)
+    flips = np.ascontiguousarray(flips, dtype=np.uint8)
+    if boxes.shape != (n, 4) or flips.shape != (n,):
+        raise ValueError(f"boxes {boxes.shape} / flips {flips.shape} mismatch n={n}")
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    scale = (1.0 / (255.0 * std)).astype(np.float32)
+    bias = (-mean / std).astype(np.float32)
+    if out is None:
+        out = np.empty((n, out_size, out_size, 3), dtype=np.float32)
+    else:
+        if out.shape != (n, out_size, out_size, 3) or out.dtype != np.float32:
+            raise ValueError(f"bad out buffer: {out.dtype} {out.shape}")
+        if not out.flags["C_CONTIGUOUS"]:
+            raise ValueError("out buffer must be C-contiguous")
+    status = np.zeros(n, dtype=np.int32)
+    c_paths = (ctypes.c_char_p * n)(
+        *[os.fsencode(p) for p in paths]
+    )
+    _lib.pdt_decode_jpeg_batch(
+        c_paths,
+        boxes.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n,
+        out_size,
+        scale.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        bias.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(dct_denom),
+        int(n_threads),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out, status

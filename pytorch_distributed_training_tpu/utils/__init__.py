@@ -70,6 +70,13 @@ def make_iter_dataloader(loader: Iterable, start_iter: int = 0) -> Generator[Tup
     draws, so augmented pixels after resume differ from a hypothetical
     uninterrupted run — sample identity and visit order are still exact.
     """
+    if hasattr(loader, "__len__") and len(loader) == 0:
+        # drop_last can leave zero full batches (dataset shard < batch size);
+        # the infinite loop below would busy-spin forever on an empty loader
+        raise ValueError(
+            "loader yields no batches (dataset shard smaller than batch size "
+            "with drop_last?) — the iteration-based loop would spin forever"
+        )
     epoch = 0
     if start_iter:
         batches_per_epoch = len(loader)

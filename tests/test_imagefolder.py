@@ -1,0 +1,212 @@
+"""Real-data input pipeline: ImageFolder decode/augment path + loader backends.
+
+Covers the VERDICT round-1 gaps: the ImageFolder/PIL path had zero tests, the
+augmentation RNG was global (non-reproducible under threading), and the
+loader's GIL-free scaling paths (native batch decode, process workers) were
+unproven.  Oracle strategy: the PIL path is the reference implementation; the
+native C++ kernel must match it within one uint8 quantization level, and the
+process pool must match the thread pool bit-for-bit (identical per-sample RNG
+streams, shared-memory handoff must not corrupt).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.data import (
+    DataLoader,
+    ImageFolderDataset,
+    RandomSampler,
+    SequentialSampler,
+    get_dataset,
+)
+from pytorch_distributed_training_tpu.data.datasets import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    fetch_sample,
+    sample_crop_params,
+    sample_rng,
+)
+from pytorch_distributed_training_tpu.native import native_available
+
+
+@pytest.fixture(scope="module")
+def jpeg_tree(tmp_path_factory):
+    """Tiny ImageNet-layout tree: 2 classes x 6 train / 3 val JPEGs of
+    varying sizes (+ 1 PNG in train to exercise the native-path fallback)."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("imagenet")
+    rng = np.random.default_rng(42)
+    for split, n in (("train", 6), ("val", 3)):
+        for cls in ("n01440764", "n01443537"):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(n):
+                base = rng.integers(0, 256, size=(12, 16, 3), dtype=np.uint8)
+                w, h = 200 + 30 * i, 160 + 20 * i
+                im = Image.fromarray(base).resize((w, h), Image.BILINEAR)
+                im.save(d / f"img_{i}.jpg", "JPEG", quality=92)
+    # one PNG: listed by the dataset, undecodable by libjpeg -> PIL fallback
+    png_base = rng.integers(0, 256, size=(40, 50, 3), dtype=np.uint8)
+    Image.fromarray(png_base).save(root / "train" / "n01440764" / "zz.png")
+    return str(root)
+
+
+# --------------------------------------------------------------- dataset API
+def test_listing_and_class_mapping(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    assert isinstance(ds, ImageFolderDataset)
+    assert ds.class_to_idx == {"n01440764": 0, "n01443537": 1}
+    assert len(ds) == 13  # 12 JPEG + 1 PNG
+    img, label = ds[0]
+    assert img.shape == (224, 224, 3) and img.dtype == np.uint8
+    assert label in (0, 1)
+
+
+def test_val_center_crop_box_math():
+    # Resize(256)+CenterCrop(224) expressed as one source box: for a 500x375
+    # image the scale is 256/375, so the box is 224*375/256 = 328.125 px.
+    x, y, cw, ch, flip = sample_crop_params(500, 375, None, train=False)
+    assert not flip
+    assert cw == pytest.approx(328.125) and ch == pytest.approx(328.125)
+    assert x == pytest.approx((500 - 328.125) / 2)
+    assert y == pytest.approx((375 - 328.125) / 2)
+
+
+def test_train_crop_params_distribution():
+    # torchvision RandomResizedCrop semantics: box inside the image, area in
+    # [0.08, 1.0] of source (up to rounding), flip rate ~ 0.5.
+    rng = sample_rng(0, 0, 0)
+    flips = 0
+    for i in range(200):
+        x, y, cw, ch, flip = sample_crop_params(300, 200, rng, train=True)
+        assert 0 <= x <= 300 - cw and 0 <= y <= 200 - ch
+        assert cw >= 1 and ch >= 1
+        assert cw * ch <= 300 * 200 * 1.05
+        flips += flip
+    assert 60 <= flips <= 140
+
+
+def test_augmentation_rng_is_per_sample_and_reproducible(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    a1, _ = fetch_sample(ds, 1, seed=7, epoch=0)
+    a2, _ = fetch_sample(ds, 1, seed=7, epoch=0)
+    np.testing.assert_array_equal(a1, a2)  # same (seed, epoch, idx) -> same bytes
+    b, _ = fetch_sample(ds, 1, seed=7, epoch=1)
+    c, _ = fetch_sample(ds, 1, seed=8, epoch=0)
+    assert not np.array_equal(a1, b)  # epoch changes the stream
+    assert not np.array_equal(a1, c)  # seed changes the stream
+    # different samples draw different params even under identical seeds
+    r1 = sample_crop_params(300, 200, sample_rng(7, 0, 1), True)
+    r2 = sample_crop_params(300, 200, sample_rng(7, 0, 2), True)
+    assert r1 != r2
+
+
+# ------------------------------------------------------------ loader backends
+def _collect(ds, mode, nw, batch_size=4, seed=11, train=True):
+    sampler = RandomSampler(len(ds), seed=seed) if train else SequentialSampler(len(ds))
+    dl = DataLoader(
+        ds,
+        batch_size=batch_size,
+        sampler=sampler,
+        num_workers=nw,
+        drop_last=train,
+        worker_mode=mode,
+    )
+    out = list(dl)
+    dl.close()
+    return out
+
+
+def test_thread_mode_batches(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    batches = _collect(ds, "thread", 2)
+    assert len(batches) == len(ds) // 4
+    img, lab = batches[0]
+    assert img.shape == (4, 224, 224, 3) and img.dtype == np.float32
+    assert lab.shape == (4,) and lab.dtype == np.int64
+    # normalized pixel stats: roughly centered
+    assert abs(float(img.mean())) < 3.0
+
+
+@pytest.mark.skipif(not native_available(), reason="native library unavailable")
+def test_native_mode_matches_pil_reference(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    bt = _collect(ds, "thread", 2)
+    bn = _collect(ds, "native", 2)
+    assert len(bt) == len(bn)
+    for (it, lt), (inat, ln) in zip(bt, bn):
+        np.testing.assert_array_equal(lt, ln)
+        # PIL rounds the resampled image to uint8 before normalization; the
+        # native kernel stays in float: bound = 1 uint8 level / min(std)
+        bound = 1.0 / 255.0 / float(IMAGENET_STD.min()) + 1e-4
+        assert float(np.abs(it - inat).max()) <= bound
+
+
+@pytest.mark.skipif(not native_available(), reason="native library unavailable")
+def test_native_mode_png_fallback_row(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    png_idx = next(i for i, (p, _) in enumerate(ds.samples) if p.endswith(".png"))
+    # force a batch containing the PNG row through the native path
+    sampler = SequentialSampler(len(ds))
+    dl = DataLoader(ds, batch_size=len(ds), sampler=sampler, num_workers=2, worker_mode="native")
+    img, _ = next(iter(dl))
+    dl.close()
+    # fallback row decoded via PIL with the SAME sampled params
+    ref, _ = fetch_sample(ds, png_idx, seed=dl.seed, epoch=0)
+    ref = (ref.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    np.testing.assert_allclose(img[png_idx], ref, atol=1e-5)
+
+
+def test_process_mode_matches_thread_bitwise(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    bt = _collect(ds, "thread", 2)
+    bp = _collect(ds, "process", 2)
+    assert len(bt) == len(bp)
+    for (it, lt), (ip, lp) in zip(bt, bp):
+        np.testing.assert_array_equal(lt, lp)
+        np.testing.assert_array_equal(it, ip)
+
+
+def test_process_pool_reuse_and_abandonment(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    sampler = RandomSampler(len(ds), seed=3)
+    dl = DataLoader(ds, batch_size=4, sampler=sampler, num_workers=2,
+                    drop_last=True, worker_mode="process")
+    try:
+        it1 = iter(dl)
+        next(it1)
+        it1.close()  # abandon mid-epoch; in-flight slots must be reclaimed
+        dl.set_epoch(1)
+        e1 = list(dl)
+        dl.set_epoch(1)
+        e1b = list(dl)
+        for (a, _), (b, _) in zip(e1, e1b):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        dl.close()
+
+
+def test_epoch_reshuffle_changes_batches(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    sampler = RandomSampler(len(ds), seed=3)
+    dl = DataLoader(ds, batch_size=4, sampler=sampler, num_workers=0, drop_last=True)
+    def batch_index_lists():
+        return [b.tolist() for b in dl._batch_indices()]
+
+    dl.set_epoch(0)
+    e0 = batch_index_lists()
+    dl.set_epoch(1)
+    e1 = batch_index_lists()
+    assert e0 != e1  # loader-visible reshuffle (13 samples: collision ~1e-10)
+    dl.set_epoch(0)
+    assert batch_index_lists() == e0  # and it is deterministic per epoch
+
+
+def test_val_loader_wrap_pad(jpeg_tree):
+    ds = get_dataset("imagenet", jpeg_tree, "val")
+    assert len(ds) == 6
+    batches = _collect(ds, "thread", 1, batch_size=4, train=False)
+    assert len(batches) == 2  # ceil(6/4)
+    assert all(img.shape[0] == 4 for img, _ in batches)  # tail wrap-padded

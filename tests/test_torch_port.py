@@ -1,0 +1,215 @@
+"""torchvision weight-port parity: torch eval logits == Flax eval logits.
+
+The reference's correctness oracle is the torchvision ImageNet accuracy
+table (/root/reference/README.md:9-13).  The cheapest strong proxy for "our
+ResNet can reach those numbers" is exact-weight logit parity: run the SAME
+weights through torch and through our Flax model and require matching
+outputs.  torchvision itself isn't installed in this image (and pretrained
+weights need network), so the torch side is a line-faithful reimplementation
+of torchvision's ``resnet.py`` topology and ``state_dict`` naming — which is
+exactly the contract ``import_torch_resnet_state_dict`` targets.  With
+*random* weights AND random BN running stats, logit agreement pins: stride
+placement (v1.5: stride on the 3x3), padding geometry, BN eps placement,
+pooling, and the classifier layout.  A single wrong stride or pad fails at
+atol 1e-4.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.models.torch_port import (
+    import_torch_resnet_state_dict,
+)
+
+
+# ----------------------------------------------------------------------
+# torchvision-faithful torch ResNet (topology + state_dict names)
+# ----------------------------------------------------------------------
+class TorchBasicBlock(tnn.Module):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inplanes, planes, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.relu = tnn.ReLU(inplace=True)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class TorchBottleneck(tnn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(planes)
+        self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(planes)
+        self.conv3 = tnn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(planes * 4)
+        self.relu = tnn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class TorchResNet(tnn.Module):
+    def __init__(self, block, layers, num_classes=1000):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = tnn.BatchNorm2d(64)
+        self.relu = tnn.ReLU(inplace=True)
+        self.maxpool = tnn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, layers[0])
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = tnn.AdaptiveAvgPool2d(1)
+        self.fc = tnn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = tnn.Sequential(
+                tnn.Conv2d(self.inplanes, planes * block.expansion, 1, stride, bias=False),
+                tnn.BatchNorm2d(planes * block.expansion),
+            )
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        layers += [block(self.inplanes, planes) for _ in range(1, blocks)]
+        return tnn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = torch.flatten(self.avgpool(x), 1)
+        return self.fc(x)
+
+
+_TORCH_CONFIGS = {
+    "ResNet18": (TorchBasicBlock, [2, 2, 2, 2]),
+    "ResNet50": (TorchBottleneck, [3, 4, 6, 3]),
+}
+
+
+def _randomize_running_stats(model: tnn.Module, seed: int) -> None:
+    """Non-trivial BN running stats so eval parity exercises them."""
+    g = torch.Generator().manual_seed(seed)
+    for m in model.modules():
+        if isinstance(m, tnn.BatchNorm2d):
+            m.running_mean = torch.randn(m.num_features, generator=g) * 0.3
+            m.running_var = torch.rand(m.num_features, generator=g) * 2.0 + 0.3
+
+
+@pytest.mark.parametrize("name", ["ResNet18", "ResNet50"])
+def test_eval_logits_match_torch(name):
+    num_classes = 10  # full topology, small head: cheaper, equally strict
+    block, layers = _TORCH_CONFIGS[name]
+    torch.manual_seed(0)
+    tmodel = TorchResNet(block, layers, num_classes=num_classes)
+    _randomize_running_stats(tmodel, seed=1)
+    tmodel.eval()
+
+    model = get_model(name, num_classes=num_classes)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    variables = import_torch_resnet_state_dict(variables, tmodel.state_dict())
+
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 64, 64, 3), dtype=np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(np.transpose(x, (0, 3, 1, 2)))).numpy()
+    out = model.apply(
+        {"params": variables["params"], "batch_stats": variables["batch_stats"]},
+        jnp.asarray(x),
+        train=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
+
+
+def test_converter_is_strict():
+    model = get_model("ResNet18", num_classes=10)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=10)
+    sd = tmodel.state_dict()
+
+    missing = dict(sd)
+    missing.pop("conv1.weight")
+    with pytest.raises(KeyError, match="conv1.weight"):
+        import_torch_resnet_state_dict(variables, missing)
+
+    extra = dict(sd)
+    extra["layer9.0.conv1.weight"] = sd["conv1.weight"]
+    with pytest.raises(KeyError, match="not consumed"):
+        import_torch_resnet_state_dict(variables, extra)
+
+    wrong_shape = dict(sd)
+    wrong_shape["fc.weight"] = torch.zeros(10, 7)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        import_torch_resnet_state_dict(variables, wrong_shape)
+
+
+def test_converted_weights_train_step_smoke():
+    """Ported weights are usable for continued training (not just eval)."""
+    from pytorch_distributed_training_tpu.engine import (
+        build_train_step,
+        init_train_state,
+    )
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        replicated_sharding,
+    )
+
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=10)
+    model = get_model("ResNet18", num_classes=10)
+    state = init_train_state(
+        model, SGD(lr=0.1, momentum=0.9), jax.random.PRNGKey(0),
+        jnp.zeros((1, 32, 32, 3)),
+    )
+    variables = import_torch_resnet_state_dict(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        tmodel.state_dict(),
+    )
+    state = state.replace(
+        params=jax.tree.map(jnp.asarray, variables["params"]),
+        batch_stats=jax.tree.map(jnp.asarray, variables["batch_stats"]),
+    )
+    mesh = make_mesh()
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = build_train_step(
+        model, SGD(lr=0.1, momentum=0.9), lambda i: 0.1, mesh, sync_bn=False
+    )
+    n = jax.device_count()
+    img = jax.device_put(
+        np.random.default_rng(0).standard_normal((4 * n, 32, 32, 3)).astype(np.float32),
+        batch_sharding(mesh, 4),
+    )
+    lab = jax.device_put(
+        np.arange(4 * n, dtype=np.int32) % 10, batch_sharding(mesh, 1)
+    )
+    state2, loss = step(state, img, lab)
+    assert np.isfinite(float(loss))
