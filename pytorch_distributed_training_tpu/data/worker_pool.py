@@ -106,7 +106,11 @@ class ProcessLoaderPool:
         self.n_slots = int(n_slots) if n_slots else self.num_workers + 2
         self.seed = int(seed)
         self._gen = 0
-        self._stale_outstanding = 0
+        # tasks submitted but not yet collected off the result queue — pool-
+        # level (not per-epoch) so an abandoned, never-closed epoch iterator
+        # can't undercount: accounting happens at submit/collect time, never
+        # in a generator finally that may not have run yet
+        self._outstanding = 0
         self._closed = False
 
         slot_bytes = (
@@ -163,52 +167,47 @@ class ProcessLoaderPool:
         filled slot into caller-owned arrays (normalize or copy); the slot is
         recycled immediately after it returns.
         """
-        # A previous epoch abandoned mid-flight leaves workers writing into
-        # slots this epoch would otherwise hand out; wait for those stale
-        # tasks to finish before rebuilding the slot ring.
-        while self._stale_outstanding > 0:
+        # Only one epoch is live at a time, so every task still uncollected
+        # here belongs to an abandoned epoch: its worker may be mid-write
+        # into a slot this epoch would otherwise hand out.  Drain them all
+        # before rebuilding the slot ring.  (The counter is maintained at
+        # submit/collect time on the pool — correct even when the abandoned
+        # iterator was never closed and its finally never ran.)
+        while self._outstanding > 0:
             self._collect_one()
-            self._stale_outstanding -= 1
         self._gen += 1
         gen = self._gen
         pending = deque(enumerate(batches))
         free = list(range(self.n_slots))
-        inflight = {}  # seq -> slot
         done = {}  # seq -> slot
         next_yield = 0
-        try:
-            while next_yield < len(batches):
-                while free and pending:
-                    seq, idxs = pending.popleft()
-                    slot = free.pop()
-                    inflight[seq] = slot
-                    self._task_q.put((gen, seq, slot, int(epoch), np.asarray(idxs)))
-                if next_yield in done:
-                    slot = done.pop(next_yield)
-                    out = postprocess(self._slots[slot], self._labels[slot])
-                    free.append(slot)
-                    next_yield += 1
-                    yield out
-                    continue
-                r = self._collect_one()
-                if r[0] != gen:  # stale result from an abandoned epoch
-                    self._stale_outstanding -= 1
-                    continue
-                _, seq, slot, err = r
-                inflight.pop(seq, None)
-                if err is not None:
-                    raise RuntimeError(f"decode worker failed:\n{err}")
-                done[seq] = slot
-        finally:
-            # Abandoned mid-epoch: record tasks still running so the next
-            # run_epoch drains them before reusing their slots. Completed-
-            # but-unclaimed results (in ``done``) are already off the queue.
-            self._stale_outstanding += len(inflight)
+        while next_yield < len(batches):
+            while free and pending:
+                seq, idxs = pending.popleft()
+                slot = free.pop()
+                self._task_q.put((gen, seq, slot, int(epoch), np.asarray(idxs)))
+                self._outstanding += 1
+            if next_yield in done:
+                slot = done.pop(next_yield)
+                out = postprocess(self._slots[slot], self._labels[slot])
+                free.append(slot)
+                next_yield += 1
+                yield out
+                continue
+            r = self._collect_one()
+            if r[0] != gen:  # stale result from an abandoned epoch
+                continue
+            _, seq, slot, err = r
+            if err is not None:
+                raise RuntimeError(f"decode worker failed:\n{err}")
+            done[seq] = slot
 
     def _collect_one(self):
         while True:
             try:
-                return self._result_q.get(timeout=5.0)
+                r = self._result_q.get(timeout=5.0)
+                self._outstanding -= 1
+                return r
             except queue.Empty:
                 dead = [p.pid for p in self._procs if not p.is_alive()]
                 if dead:

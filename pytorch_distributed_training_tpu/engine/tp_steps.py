@@ -15,11 +15,9 @@ follow-up that slots into the same builder.
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import cross_entropy_loss

@@ -156,6 +156,12 @@ def fused_cross_entropy(logits, labels, *, interpret: bool = False):
     Drop-in for :func:`..ops.losses.cross_entropy_loss` (same semantics:
     mean reduction, fp32 compute, ``torch.nn.CrossEntropyLoss`` defaults).
 
+    Precondition: every label must lie in ``[0, C)``.  An out-of-range label
+    makes the where-based gather contribute ``true_logit = 0`` — a finite but
+    wrong loss — whereas ``torch.nn.CrossEntropyLoss`` raises and the XLA
+    ``take_along_axis`` path clamps; validate labels at the data boundary
+    (the ``ImageFolderDataset``/token pipelines only emit in-range labels).
+
     Args:
       interpret: run the kernels in Pallas interpreter mode (for CPU test
         meshes); on TPU leave False.

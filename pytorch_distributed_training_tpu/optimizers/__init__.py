@@ -87,19 +87,19 @@ class SGD:
         return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
 
 
-def _is_excluded(path) -> bool:
+def _is_excluded(param) -> bool:
     """True for params LARS should not adapt: biases + norm scales/offsets.
 
-    Matches the standard large-batch recipe (LARS paper / MLPerf ResNet): BN
-    parameters and biases get neither weight decay nor the trust-ratio
-    scaling.  Detection is by parameter-tree path: our BatchNorm params live
-    under a ``*bn*`` module scope and are named ``scale`` / ``bias``.
+    Matches the standard large-batch recipe (LARS paper / MLPerf ResNet):
+    normalization parameters and biases get neither weight decay nor the
+    trust-ratio scaling.  Detection is by parameter *role*, not name: every
+    such parameter is rank-0/1 (bias vectors, BatchNorm/LayerNorm scale and
+    offset), while every matmul/conv/embedding weight is rank>=2.  This makes
+    the rule model-family-agnostic — it is exactly right for the ResNet tree
+    AND for transformer trees, where name-matching on "bn" would silently
+    give LayerNorm scales (``ln1``/``ln2``) trust-ratio updates.
     """
-    keys = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
-    last = keys[-1] if keys else ""
-    if last == "bias":
-        return True
-    return any("bn" in str(k).lower() or "batchnorm" in str(k).lower() for k in keys)
+    return jnp.ndim(param) <= 1
 
 
 class LARS:
@@ -136,8 +136,8 @@ class LARS:
             lr = self.lr
         mu, wd, eta, eps = self.momentum, self.weight_decay, self.eta, self.eps
 
-        def one(path, g, p, buf):
-            if _is_excluded(path):
+        def one(g, p, buf):
+            if _is_excluded(p):
                 d = g
             else:
                 p_norm = jnp.linalg.norm(p.reshape(-1))
@@ -151,7 +151,7 @@ class LARS:
             new_buf = mu * buf + d
             return p - lr * new_buf, new_buf
 
-        flat = jax.tree_util.tree_map_with_path(one, grads, params, state.momentum)
+        flat = jax.tree.map(one, grads, params, state.momentum)
         new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
         new_bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
         return new_params, SGDState(momentum=new_bufs, step=state.step + 1)

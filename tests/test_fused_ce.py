@@ -48,6 +48,33 @@ def test_bf16_logits_fp32_loss():
     assert g.dtype == jnp.bfloat16
 
 
+@pytest.mark.parametrize("b", [200, 300])
+def test_multi_tile_forward(b):
+    """b > _TILE_B=128 exercises the multi-instance grid, including a partial
+    final block (200 % 128 = 72, 300 % 128 = 44) — the production path for
+    LM losses where b = B*S (ADVICE.md r1)."""
+    c = 1000
+    rng = np.random.default_rng(4)
+    logits = jnp.asarray(rng.standard_normal((b, c)) * 3, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, c, (b,)), jnp.int32)
+    ref = cross_entropy_loss(logits, labels)
+    got = fused_cross_entropy(logits, labels, interpret=True)
+    assert np.isclose(float(got), float(ref), rtol=1e-5), (got, ref)
+
+
+@pytest.mark.parametrize("b", [200, 300])
+def test_multi_tile_backward(b):
+    c = 257
+    rng = np.random.default_rng(5)
+    logits = jnp.asarray(rng.standard_normal((b, c)) * 2, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, c, (b,)), jnp.int32)
+    ref_grad = jax.grad(lambda x: cross_entropy_loss(x, labels))(logits)
+    got_grad = jax.grad(
+        lambda x: fused_cross_entropy(x, labels, interpret=True)
+    )(logits)
+    np.testing.assert_allclose(np.asarray(got_grad), np.asarray(ref_grad), atol=1e-6)
+
+
 def test_jit_and_big_logit_stability():
     """Large logits must not overflow (max-subtracted logsumexp)."""
     rng = np.random.default_rng(3)

@@ -188,6 +188,30 @@ def test_process_pool_reuse_and_abandonment(jpeg_tree):
         dl.close()
 
 
+def test_process_pool_abandoned_iterator_never_closed(jpeg_tree):
+    """The hard abandonment case (r2 code review): the old epoch iterator is
+    still referenced and never closed, so its generator finally has NOT run
+    when the next epoch starts.  Slot accounting must live on the pool
+    (submit/collect time) for the new epoch to drain the old tasks instead
+    of handing their slots out while workers are still writing."""
+    ds = get_dataset("imagenet", jpeg_tree, "train")
+    sampler = RandomSampler(len(ds), seed=5)
+    dl = DataLoader(ds, batch_size=4, sampler=sampler, num_workers=2,
+                    drop_last=True, worker_mode="process")
+    try:
+        it1 = iter(dl)
+        next(it1)  # epoch 0 mid-flight; keep it1 alive, do NOT close it
+        dl.set_epoch(1)
+        e1 = list(dl)  # must not tear batches against epoch-0 writers
+        del it1
+        dl.set_epoch(1)
+        e1b = list(dl)
+        for (a, _), (b, _) in zip(e1, e1b):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        dl.close()
+
+
 def test_epoch_reshuffle_changes_batches(jpeg_tree):
     ds = get_dataset("imagenet", jpeg_tree, "train")
     sampler = RandomSampler(len(ds), seed=3)

@@ -99,3 +99,57 @@ def test_lars_smoke():
     for leaf in jax.tree.leaves(new_params):
         assert np.all(np.isfinite(np.asarray(leaf)))
         assert not np.allclose(np.asarray(leaf), 1.0)
+
+
+def _lars_excluded_paths(params):
+    """Paths LARS excludes from trust-ratio scaling, per the rank<=1 rule."""
+    from pytorch_distributed_training_tpu.optimizers import _is_excluded
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        if _is_excluded(leaf):
+            out.append("/".join(str(getattr(k, "key", k)) for k in path))
+    return out
+
+
+def test_lars_exclusion_resnet_tree():
+    """On the ResNet tree the rank rule excludes exactly BN scale/bias + fc bias.
+
+    VERDICT.md weak #5: the old '"bn" in path' substring was silently
+    model-family-specific; the rank<=1 rule must reproduce its ResNet
+    behavior exactly.
+    """
+    from pytorch_distributed_training_tpu.models import get_model
+
+    model = get_model("ResNet18", num_classes=10)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False
+    )["params"]
+    excluded = _lars_excluded_paths(params)
+    assert excluded, "ResNet tree must have excluded params"
+    for path in excluded:
+        assert ("bn" in path.lower()) or path.endswith("bias"), path
+    # every conv/fc kernel gets the trust ratio
+    kernels = [
+        p
+        for p, _ in (
+            ("/".join(str(getattr(k, "key", k)) for k in pth), leaf)
+            for pth, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        )
+        if p.endswith("kernel")
+    ]
+    assert kernels and not (set(kernels) & set(excluded))
+
+
+def test_lars_exclusion_lm_tree():
+    """LayerNorm scales in a transformer tree must be excluded (VERDICT weak #5:
+    the substring rule would have trust-ratio-scaled ln1/ln2 scales)."""
+    from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+
+    lm = TransformerLM(vocab_size=32, max_len=16, embed_dim=16, depth=1, num_heads=2)
+    params = lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    excluded = set(_lars_excluded_paths(params))
+    ln_scales = {p for p in excluded if "ln" in p and p.endswith("scale")}
+    assert ln_scales, f"LayerNorm scales must be excluded, got {sorted(excluded)}"
+    # embeddings and matmul kernels are rank>=2: never excluded
+    assert not any("embedding" in p or p.endswith("kernel") for p in excluded)

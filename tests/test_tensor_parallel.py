@@ -1,0 +1,134 @@
+"""Tensor parallelism: GSPMD TP step vs single-device oracle.
+
+VERDICT.md r1 #5 / ADVICE.md r1 (medium): the TP path shipped with zero
+coverage.  Two properties pin it down:
+
+  1. spec coverage — ``lm_tp_param_specs`` must hit every Megatron-shardable
+     param of a REAL ``TransformerLM`` tree (qkv/fc1 column, proj/fc2 row),
+     and nothing else;
+  2. numerics — one DP(2) x TP(4) step on the 8-fake-device mesh must equal
+     the single-device step on the full batch (loss AND updated params),
+     which only holds if the partitioner's collectives (partial-sum
+     all-reduce after row-parallel matmuls, gradient all-reduce over data)
+     are all inserted correctly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_tpu.engine import TrainState
+from pytorch_distributed_training_tpu.engine.tp_steps import build_tp_lm_train_step
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.ops import cross_entropy_loss
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.parallel import make_mesh
+from pytorch_distributed_training_tpu.parallel.tensor import (
+    lm_tp_param_specs,
+    lm_tp_shardings,
+)
+from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+VOCAB, SEQ, BATCH = 64, 16, 8
+
+
+def _model():
+    # embed_dim=32, heads=4: TP=4 puts one head per shard; fc1 128/4=32
+    return TransformerLM(
+        vocab_size=VOCAB, max_len=SEQ, embed_dim=32, depth=2, num_heads=4,
+        seq_axis=None,
+    )
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+    return jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:])
+
+
+def test_tp_specs_cover_transformer_tree():
+    """_spec_for must shard every qkv/fc1 (column) and proj/fc2 (row) param
+    of the real TransformerLM tree and replicate everything else."""
+    model = _model()
+    tokens, _ = _data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    specs = lm_tp_param_specs(params)
+
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): spec
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )[0]
+    }
+    sharded = {p for p, s in flat.items() if s != P()}
+    assert sharded, "no params sharded — _spec_for matched nothing"
+    # per block: qkv kernel+bias, proj kernel, fc1 kernel+bias, fc2 kernel
+    for blk in ("block0", "block1"):
+        assert flat[f"{blk}/attn/qkv/kernel"] == P(None, "model")
+        assert flat[f"{blk}/attn/qkv/bias"] == P("model")
+        assert flat[f"{blk}/attn/proj/kernel"] == P("model", None)
+        assert flat[f"{blk}/mlp/fc1/kernel"] == P(None, "model")
+        assert flat[f"{blk}/mlp/fc1/bias"] == P("model")
+        assert flat[f"{blk}/mlp/fc2/kernel"] == P("model", None)
+    expected = {
+        f"{blk}/{name}"
+        for blk in ("block0", "block1")
+        for name in (
+            "attn/qkv/kernel", "attn/qkv/bias", "attn/proj/kernel",
+            "mlp/fc1/kernel", "mlp/fc1/bias", "mlp/fc2/kernel",
+        )
+    }
+    assert sharded == expected, sharded ^ expected
+    # embeddings / layernorms / head / proj+fc2 biases stay replicated
+    for p in ("tok_embedding", "pos_embedding", "ln/scale", "head/kernel",
+              "block0/attn/proj/bias", "block0/mlp/fc2/bias"):
+        assert flat[p] == P(), p
+
+
+def test_tp_step_matches_single_device():
+    tokens, labels = _data(seed=1)
+    opt = SGD(lr=0.05, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.05, [], 0.1)
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    # ---- single-device reference ------------------------------------------
+    def ref_loss(p):
+        logits = model.apply({"params": p}, tokens)
+        return cross_entropy_loss(
+            logits.reshape(-1, VOCAB), labels.reshape(-1)
+        )
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    params_ref, _ = opt.update(grads_ref, opt.init(params), params, 0.05)
+
+    # ---- DP(2) x TP(4) GSPMD step -----------------------------------------
+    from pytorch_distributed_training_tpu.parallel.tensor import tp_state_shardings
+
+    mesh = make_mesh(model_parallelism=4)
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    # place the state in its TP layout before the first call
+    state = jax.device_put(state, tp_state_shardings(state, mesh))
+    step = build_tp_lm_train_step(model, opt, lr_fn, mesh, donate=False)(state)
+    state2, loss_tp = step(state, tokens, labels)
+
+    assert np.isclose(float(loss_tp), float(loss_ref), atol=1e-5), (loss_tp, loss_ref)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_ref),
+        jax.tree_util.tree_leaves(state2.params),
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
+def test_tp_shardings_match_specs():
+    """lm_tp_shardings mirrors lm_tp_param_specs with NamedShardings."""
+    model = _model()
+    tokens, _ = _data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mesh = make_mesh(model_parallelism=4)
+    shardings = lm_tp_shardings(params, mesh)
+    specs = lm_tp_param_specs(params)
+    for sh, sp in zip(
+        jax.tree_util.tree_leaves(shardings), jax.tree_util.tree_leaves(specs)
+    ):
+        assert sh.spec == sp

@@ -84,7 +84,10 @@ def test_sp_step_ulysses_matches_single_device():
         logits = ref_model.apply({"params": p}, tokens)
         return lm_loss_local(logits, labels, labels.size)
 
-    loss_ref = ref_loss(params)
+    # param-level oracle too (ADVICE.md r1: loss-only would miss a wrong
+    # all_to_all transpose in the ulysses backward)
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    params_ref, _ = opt.update(grads_ref, opt.init(params), params, 0.05)
 
     mesh = make_sp_mesh(sequence_parallelism=4)
     sp_model = TransformerLM(
@@ -94,5 +97,10 @@ def test_sp_step_ulysses_matches_single_device():
     state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
     state = jax.device_put(state, replicated_sharding(mesh))
     step = build_lm_train_step(sp_model, opt, lr_fn, mesh)
-    _, loss_sp = step(state, tokens, labels)
+    state2, loss_sp = step(state, tokens, labels)
     assert np.isclose(float(loss_sp), float(loss_ref), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_ref),
+        jax.tree_util.tree_leaves(state2.params),
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
