@@ -18,7 +18,9 @@ Loop parity (reference line refs inline):
     loss/acc1/acc5, AverageMeter accumulation, rank-0 logging + TB (:301-331),
   - batch division: per-device batch = ``batch_size / local_device_count``
     (the reference divides by *local* GPU count, :194 — global batch scales
-    with node count; replicated deliberately, SURVEY.md §7 stage 4),
+    with node count; replicated deliberately, SURVEY.md §7 stage 4).  The
+    config-gated alternative ``training.batch_division: world`` divides by
+    the world device count instead (cfg batch_size == global batch),
   - the val loader reuses the *training* batch size / workers (:235-241);
     the YAML ``validation:`` section stays dead (parity).
 
@@ -167,13 +169,31 @@ class Runner:
         batch_size = train_cfg["batch_size"]
         n_workers = train_cfg["num_workers"]
         local_devices = jax.local_device_count()
+        # SURVEY §7 stage 4 decision, config-gated (additive key, unknown to
+        # the reference schema):
+        #   batch_division: local  — reference parity (:194): per-device batch
+        #       divides by the LOCAL device count, so the global batch scales
+        #       with node count (default).
+        #   batch_division: world  — divide by the WORLD device count, so cfg
+        #       batch_size IS the global batch at any topology.
+        division = train_cfg.get("batch_division", "local")
+        if division not in ("local", "world"):
+            raise ValueError(
+                f"training.batch_division must be 'local' or 'world', got {division!r}"
+            )
         if self.distributed:
-            # Reference semantics (:194): per-device batch divides by the
-            # LOCAL device count; global batch scales with node count.
-            per_device_batch = batch_size // local_devices
+            divisor = self.world_size if division == "world" else local_devices
+            per_device_batch = batch_size // divisor
             if per_device_batch == 0:
                 raise ValueError(
-                    f"batch_size {batch_size} < local device count {local_devices}"
+                    f"batch_size {batch_size} < {division} device count {divisor}"
+                )
+            if division == "world" and batch_size % divisor != 0:
+                # the mode's whole contract is "cfg batch_size IS the global
+                # batch" — a silent floor would break it, so fail loudly
+                raise ValueError(
+                    f"batch_division: world requires batch_size ({batch_size}) "
+                    f"divisible by the world device count ({divisor})"
                 )
             host_batch = per_device_batch * local_devices
         else:

@@ -1,0 +1,138 @@
+"""Subprocess worker for the multi-host execution test.
+
+Runs the REAL multi-host path end to end in one OS process per "host":
+``jax.distributed.initialize`` over the coordination service (the
+reference's ``dist.init_process_group`` rendezvous,
+/root/reference/train_distributed.py:149-154), a global mesh spanning both
+processes' virtual CPU devices, per-host ``DistributedShardSampler`` shards,
+and ``jax.make_array_from_process_local_data`` batch assembly — the code
+paths that single-process tests cannot reach.
+
+Driven by tests/test_multihost.py via environment variables:
+  MH_RANK           process id (0-based)
+  MH_NUM_NODES      number of processes ("hosts")
+  MH_PORT           coordinator port on 127.0.0.1
+  MH_OUT            output JSON path (plus <MH_OUT>.npz for final params)
+  MH_LOCAL_DEVICES  virtual CPU devices per process
+  MH_BATCH_DIVISION training.batch_division value ("local" or "world")
+
+The platform must be pinned to CPU *before* mesh construction because a
+site-installed accelerator plugin may force ``jax_platforms`` to itself.
+"""
+import json
+import os
+import sys
+
+rank = int(os.environ["MH_RANK"])
+num_nodes = int(os.environ["MH_NUM_NODES"])
+port = os.environ["MH_PORT"]
+out_path = os.environ["MH_OUT"]
+local_devices = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={local_devices}"
+)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from pytorch_distributed_training_tpu.engine import Runner  # noqa: E402
+
+
+class _RecordingTB:
+    """Minimal SummaryWriter stand-in capturing every scalar write."""
+
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), int(step)))
+
+
+class _RecordingRunner(Runner):
+    """Runner that additionally records the per-iteration loss scalar."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.losses = []
+
+    def train_iter(self, g_img, g_label):
+        self.state, loss = self.train_step(self.state, g_img, g_label)
+        self.losses.append(float(loss))
+        self.scheduler.step()  # per-iteration, reference :299
+
+
+def main():
+    cfg = {
+        "dataset": {
+            "name": "synthetic",
+            "root": "/unused",
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 128,
+        },
+        "training": {
+            "optimizer": {
+                "name": "SGD",
+                # small lr: keeps the 4-step trajectory out of the chaotic
+                # large-step regime so cross-topology float32 reduction-order
+                # noise stays at tolerance scale instead of amplifying
+                "lr": 0.001,
+                "weight_decay": 1.0e-4,
+                "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": 4,
+            "print_interval": 1,
+            "val_interval": 100,  # is_val still fires on the last iter (p3)
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,
+            "batch_division": os.environ.get("MH_BATCH_DIVISION", "world"),
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+    tb = _RecordingTB()
+    runner = _RecordingRunner(
+        num_nodes=num_nodes,
+        rank=rank,
+        seed=1029,
+        dist_url=f"tcp://127.0.0.1:{port}",
+        dist_backend="tpu",
+        multiprocessing=False,
+        logger_queue=None,
+        global_cfg=cfg,
+        tb_writer_constructor=lambda: tb,
+    )
+    runner()
+
+    params = jax.tree.leaves(jax.tree.map(np.asarray, runner.state.params))
+    np.savez(out_path + ".npz", **{f"p{i}": p for i, p in enumerate(params)})
+    with open(out_path, "w") as fp:
+        json.dump(
+            {
+                "rank": rank,
+                "process_count": jax.process_count(),
+                "world_size": runner.world_size,
+                "global_batch": runner.global_batch,
+                "losses": runner.losses,
+                "eval": {t: v for t, v, _ in tb.scalars if t.startswith("eval/")},
+                "param_bytes_digest": __import__("hashlib").sha256(
+                    b"".join(p.tobytes() for p in params)
+                ).hexdigest(),
+            },
+            fp,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Multi-host execution test (VERDICT round-1 item #3).
+
+Launches tests/multihost_worker.py as real OS processes: two 4-device
+processes rendezvous through ``jax.distributed.initialize`` (the
+reference's ``dist.init_process_group``,
+/root/reference/train_distributed.py:149-154) and train ResNet-18 end to
+end through the full Runner — per-host ``DistributedShardSampler`` shards,
+``make_array_from_process_local_data`` batch assembly, in-graph psum over a
+mesh that spans both processes.  A third, single-process 8-device run of the
+same config is the semantic oracle.
+
+Asserts:
+  - both ranks finish and agree bitwise on the final replicated params
+    (cross-host state consistency);
+  - with ``batch_division: world`` the 2-process topology sees the same
+    global batch (16) as the single-process run, and because the global
+    per-step sample SETS coincide (interleaved shard union == contiguous
+    block) and SyncBN makes the step permutation-invariant, the loss
+    trajectory and final params match the single-process oracle to
+    float32-reduction tolerance;
+  - with the default ``batch_division: local`` (reference :194 parity) the
+    2-process global batch doubles (32) — the scales-with-node-count
+    semantics.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_ROOT, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    # the workers pin their own platform/device-count flags
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def _launch(rank, num_nodes, port, out, local_devices, division="world"):
+    env = _clean_env()
+    env.update(
+        MH_RANK=str(rank),
+        MH_NUM_NODES=str(num_nodes),
+        MH_PORT=str(port),
+        MH_OUT=out,
+        MH_LOCAL_DEVICES=str(local_devices),
+        MH_BATCH_DIVISION=division,
+    )
+    return subprocess.Popen(
+        [sys.executable, _WORKER],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _wait(proc, what, timeout=900):
+    out, _ = proc.communicate(timeout=timeout)
+    assert proc.returncode == 0, f"{what} failed (rc={proc.returncode}):\n{out}"
+
+
+def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
+    port = _free_port()
+    outs = [str(tmp_path / f"{tag}_rank{r}.json") for r in range(n_procs)]
+    procs = [
+        _launch(r, n_procs, port, outs[r], local_devices, division)
+        for r in range(n_procs)
+    ]
+    try:
+        for r, p in enumerate(procs):
+            _wait(p, f"{tag} rank {r}")
+    finally:
+        # if one rank fails, its sibling blocks at the rendezvous/collective —
+        # don't leave orphans (or burn the sibling's full timeout)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    results = []
+    for o in outs:
+        with open(o) as fp:
+            results.append(json.load(fp))
+        results[-1]["params"] = np.load(o + ".npz")
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_runner_matches_single_process(tmp_path):
+    two = _run_topology(tmp_path, "dist", n_procs=2, local_devices=4)
+    one = _run_topology(tmp_path, "single", n_procs=1, local_devices=8)
+
+    r0, r1 = two
+    assert r0["process_count"] == 2 and r0["world_size"] == 8
+    assert one[0]["process_count"] == 1 and one[0]["world_size"] == 8
+    # world-division: cfg batch_size is the global batch at both topologies
+    assert r0["global_batch"] == one[0]["global_batch"] == 16
+
+    # cross-host consistency: the replicated state is BITWISE identical on
+    # both processes after 4 steps (grad psum + SyncBN keep replicas in sync)
+    assert r0["param_bytes_digest"] == r1["param_bytes_digest"]
+    assert np.allclose(r0["losses"], r1["losses"], rtol=0, atol=0)
+
+    # semantic oracle: same per-step global sample sets => same trajectory.
+    # The residual is float32 cross-device reduction-order noise, which an
+    # untrained BN net amplifies ~30-50x per step (measured) — so the bound
+    # is tight where a semantic bug (wrong grad scale, wrong shard) would
+    # show instantly (steps 0-1) and Lyapunov-scaled after.
+    np.testing.assert_allclose(r0["losses"][:2], one[0]["losses"][:2], rtol=1e-4)
+    np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=2e-2)
+    for key in one[0]["params"].files:
+        np.testing.assert_allclose(
+            r0["params"][key], one[0]["params"][key], rtol=0, atol=2e-3,
+            err_msg=key,
+        )
+    # the final-iteration distributed eval also agrees (rank-0 TB scalars);
+    # accuracy is quantized at 100/128 pts per val sample, and the ~1e-3
+    # param noise can flip a borderline sample's top-k membership — allow
+    # two sample-quanta
+    assert abs(r0["eval"]["eval/loss"] - one[0]["eval"]["eval/loss"]) < 0.01
+    for tag in ("eval/Acc@1", "eval/Acc@5"):
+        assert abs(r0["eval"][tag] - one[0]["eval"][tag]) <= 2 * 100.0 / 128, (
+            tag,
+            r0["eval"],
+            one[0]["eval"],
+        )
+
+
+@pytest.mark.slow
+def test_two_process_local_division_scales_global_batch(tmp_path):
+    """Reference parity (:194): batch divides by LOCAL device count, so the
+    2-process global batch is 2x the config value."""
+    two = _run_topology(
+        tmp_path, "local_div", n_procs=2, local_devices=4, division="local"
+    )
+    assert two[0]["global_batch"] == 32
+    assert two[0]["param_bytes_digest"] == two[1]["param_bytes_digest"]
+    assert np.isfinite(two[0]["losses"]).all()
