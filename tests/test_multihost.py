@@ -59,21 +59,34 @@ def _launch(rank, num_nodes, port, out, local_devices, division="world"):
         MH_LOCAL_DEVICES=str(local_devices),
         MH_BATCH_DIVISION=division,
     )
-    return subprocess.Popen(
+    # log to a FILE, not a pipe: ranks are waited on sequentially, and an
+    # unread sibling pipe filling the OS buffer would block that rank
+    # mid-collective and deadlock the whole topology until the timeout
+    log = open(out + ".log", "w")
+    proc = subprocess.Popen(
         [sys.executable, _WORKER],
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=log,
         stderr=subprocess.STDOUT,
         text=True,
     )
+    proc._log_file = log  # noqa: SLF001 — for cleanup + failure reporting
+    return proc
 
 
 def _wait(proc, what, timeout=900):
-    out, _ = proc.communicate(timeout=timeout)
+    try:
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+    proc._log_file.close()
+    with open(proc._log_file.name) as fp:
+        out = fp.read()
     assert proc.returncode == 0, f"{what} failed (rc={proc.returncode}):\n{out}"
 
 
-def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
+def _run_topology_once(tmp_path, tag, n_procs, local_devices, division):
     port = _free_port()
     outs = [str(tmp_path / f"{tag}_rank{r}.json") for r in range(n_procs)]
     procs = [
@@ -89,6 +102,23 @@ def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    return outs
+
+
+def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
+    try:
+        outs = _run_topology_once(tmp_path, tag, n_procs, local_devices, division)
+    except AssertionError as e:
+        # _free_port releases the probe socket before the workers rebind it —
+        # another process can steal the port in that window; retry once on a
+        # fresh port before declaring failure
+        if "Failed to bind" not in str(e) and "address already in use" not in str(
+            e
+        ).lower():
+            raise
+        outs = _run_topology_once(
+            tmp_path, tag + "_retry", n_procs, local_devices, division
+        )
     results = []
     for o in outs:
         with open(o) as fp:

@@ -1,0 +1,286 @@
+"""Determinism + subsystem-interaction oracles (VERDICT round-1 items #6/#7).
+
+SURVEY.md §5.2 prescribes a seeded-run bitwise-reproducibility test in place
+of the sanitizer tooling the reference lacks: two fresh Runner runs with the
+same seed must produce byte-identical parameters — on the synthetic dataset
+AND on the real ImageFolder decode/augment path (per-sample augmentation RNG
++ native batch decode + thread/process scheduling must all be invisible).
+
+Also pins two round-1 "weak" claims:
+  - non-sync BN (``sync_bn: False``): the documented deviation averages
+    per-replica batch stats (engine/steps.py) — the stats must equal the
+    mean of per-shard local stats, and averaging must be the identity when
+    every replica sees identical data (the "same fixed point as DDP
+    broadcast_buffers" claim);
+  - the Runner-integrated profiler/checkpoint stop/re-arm/wait sequence.
+"""
+import hashlib
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.engine import (
+    Runner,
+    build_train_step,
+    init_train_state,
+)
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.parallel import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+
+class _FakeTB:
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), int(step)))
+
+
+def _base_cfg(dataset: dict) -> dict:
+    return {
+        "dataset": dataset,
+        "training": {
+            "optimizer": {
+                "name": "SGD",
+                "lr": 0.01,
+                "weight_decay": 1.0e-4,
+                "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": 4,
+            "print_interval": 1,
+            "val_interval": 100,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+
+
+def _run_once(cfg, seed=1029):
+    tb = _FakeTB()
+    runner = Runner(
+        num_nodes=1,
+        rank=0,
+        seed=seed,
+        dist_url="tcp://127.0.0.1:9931",
+        dist_backend="tpu",
+        multiprocessing=False,
+        logger_queue=None,
+        global_cfg=cfg,
+        tb_writer_constructor=lambda: tb,
+    )
+    runner()
+    leaves = jax.tree.leaves(jax.tree.map(np.asarray, runner.state.params))
+    leaves += jax.tree.leaves(jax.tree.map(np.asarray, runner.state.batch_stats))
+    digest = hashlib.sha256(b"".join(p.tobytes() for p in leaves)).hexdigest()
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    return digest, losses
+
+
+def test_runner_bitwise_reproducible_synthetic(tmp_path):
+    cfg = _base_cfg(
+        {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 64,
+        }
+    )
+    d1, l1 = _run_once(cfg)
+    d2, l2 = _run_once(cfg)
+    assert l1 == l2  # loss scalars bitwise equal, every iteration
+    assert d1 == d2  # param + BN-stat bytes identical
+
+
+@pytest.fixture(scope="module")
+def small_jpeg_tree(tmp_path_factory):
+    """2-class ImageFolder tree with enough train JPEGs for 4 iters @ 16."""
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("repro_imagenet")
+    rng = np.random.default_rng(7)
+    for split, n in (("train", 36), ("val", 8)):
+        for cls in ("c0", "c1"):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(n):
+                base = rng.integers(0, 256, size=(12, 16, 3), dtype=np.uint8)
+                im = Image.fromarray(base).resize((90 + 7 * i, 70 + 5 * i))
+                im.save(d / f"img_{i}.jpg", "JPEG", quality=90)
+    return str(root)
+
+
+@pytest.mark.parametrize("worker_mode", ["auto", "process"])
+def test_runner_bitwise_reproducible_imagefolder(small_jpeg_tree, worker_mode):
+    """Real-data path: JPEG decode + RandomResizedCrop/flip augmentation +
+    loader parallelism is bit-reproducible run to run (the per-sample
+    (seed, epoch, idx) RNG makes augmentation independent of worker
+    scheduling; shared-memory handoff must not corrupt)."""
+    cfg = _base_cfg(
+        {
+            "name": "imagenet",
+            "root": small_jpeg_tree,
+            "n_classes": 2,
+            "image_size": 32,
+        }
+    )
+    cfg["training"]["worker_mode"] = worker_mode
+    d1, l1 = _run_once(cfg)
+    d2, l2 = _run_once(cfg)
+    assert l1 == l2
+    assert d1 == d2
+
+
+# --------------------------------------------------- non-sync BN fixed point
+def _bn_setup(n_classes=8):
+    model = get_model("ResNet18", num_classes=n_classes, axis_name=None)
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.01, [1000], 0.1)
+    import jax.numpy as jnp
+
+    state0 = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    return model, opt, lr_fn, state0
+
+
+def test_nonsync_bn_stats_are_mean_of_local_stats():
+    """sync_bn=False on N devices: updated batch_stats == mean over shards of
+    the stats a single device computes on its local shard alone (the
+    documented averaging deviation, engine/steps.py)."""
+    model, opt, lr_fn, state0 = _bn_setup()
+    rng = np.random.default_rng(3)
+    img = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    label = rng.integers(0, 8, (16,)).astype(np.int32)
+
+    mesh8 = make_mesh()
+    step8 = build_train_step(model, opt, lr_fn, mesh8, sync_bn=False, donate=False)
+    s8, _ = step8(
+        jax.device_put(state0, replicated_sharding(mesh8)),
+        jax.device_put(img, batch_sharding(mesh8, 4)),
+        jax.device_put(label, batch_sharding(mesh8, 1)),
+    )
+
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    step1 = build_train_step(model, opt, lr_fn, mesh1, sync_bn=False, donate=False)
+    shard_stats = []
+    for d in range(8):
+        s1, _ = step1(
+            jax.device_put(state0, replicated_sharding(mesh1)),
+            jax.device_put(img[2 * d : 2 * d + 2], batch_sharding(mesh1, 4)),
+            jax.device_put(label[2 * d : 2 * d + 2], batch_sharding(mesh1, 1)),
+        )
+        shard_stats.append(jax.tree.map(np.asarray, s1.batch_stats))
+    mean_stats = jax.tree.map(
+        lambda *xs: np.mean(np.stack(xs), axis=0), *shard_stats
+    )
+    for a, b in zip(jax.tree.leaves(s8.batch_stats), jax.tree.leaves(mean_stats)):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=2e-5, atol=1e-6)
+
+
+def test_nonsync_bn_identical_shards_is_fixed_point():
+    """When every replica sees the same local data, averaging the local stats
+    is the identity — the N-device non-sync state equals the 1-device state
+    (the 'same fixed point as DDP broadcast_buffers' claim)."""
+    model, opt, lr_fn, state0 = _bn_setup()
+    rng = np.random.default_rng(4)
+    shard_img = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    shard_label = rng.integers(0, 8, (2,)).astype(np.int32)
+    img = np.tile(shard_img, (8, 1, 1, 1))
+    label = np.tile(shard_label, (8,))
+
+    mesh8 = make_mesh()
+    step8 = build_train_step(model, opt, lr_fn, mesh8, sync_bn=False, donate=False)
+    s8, _ = step8(
+        jax.device_put(state0, replicated_sharding(mesh8)),
+        jax.device_put(img, batch_sharding(mesh8, 4)),
+        jax.device_put(label, batch_sharding(mesh8, 1)),
+    )
+
+    mesh1 = make_mesh(devices=jax.devices()[:1])
+    step1 = build_train_step(model, opt, lr_fn, mesh1, sync_bn=False, donate=False)
+    s1, _ = step1(
+        jax.device_put(state0, replicated_sharding(mesh1)),
+        jax.device_put(shard_img, batch_sharding(mesh1, 4)),
+        jax.device_put(shard_label, batch_sharding(mesh1, 1)),
+    )
+    for a, b in zip(jax.tree.leaves(s8.batch_stats), jax.tree.leaves(s1.batch_stats)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------- profiler + checkpoint integration
+class _CaptureHandler(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_runner_profiler_checkpoint_integration(tmp_path):
+    """Runner drives profiler and checkpointer together: the trace window
+    interrupted by validation re-arms and completes later, checkpoints land
+    at the configured interval + final iter, and a trace is produced."""
+    cfg = _base_cfg(
+        {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 64,
+        }
+    )
+    cfg["training"]["train_iters"] = 8
+    cfg["training"]["val_interval"] = 3  # val fires at iters 2, 5, 7
+    cfg["training"]["profile"] = {
+        # window opens after iter 2 — the SAME iter validation fires, so the
+        # first window closes with zero captured steps and must re-arm
+        "dir": str(tmp_path / "trace"),
+        "start_iter": 2,
+        "n_iters": 2,
+    }
+    cfg["training"]["checkpoint"] = {
+        "dir": str(tmp_path / "ckpt"),
+        "interval": 3,  # saves at iters 2, 5 (+ final 7)
+    }
+    # the worker logger has propagate=False (reference parity), so capture
+    # its records with an explicit handler instead of caplog
+    capture = _CaptureHandler()
+    worker_logger = logging.getLogger("worker_rank_0")
+    worker_logger.addHandler(capture)
+    try:
+        _run_once(cfg)
+    finally:
+        worker_logger.removeHandler(capture)
+
+    # the interrupted window re-armed (zero-capture close logs a warning)...
+    messages = [r.getMessage() for r in capture.records]
+    assert any("re-arming" in m for m in messages), messages
+    # ...and a trace was eventually captured on a later quiet stretch
+    trace_files = [
+        os.path.join(dp, f)
+        for dp, _, fs in os.walk(tmp_path / "trace")
+        for f in fs
+    ]
+    assert trace_files, "no trace produced"
+
+    from pytorch_distributed_training_tpu.engine.checkpoint import Checkpointer
+
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), interval=3)
+    assert ckpt.latest() == 7
+    ckpt.close()
