@@ -10,6 +10,8 @@ from .datasets import (
     IMAGENET_STD,
     ImageFolderDataset,
     SyntheticDataset,
+    SyntheticTextDataset,
+    TokenFileDataset,
     get_dataset,
 )
 from .loader import DataLoader
@@ -19,6 +21,8 @@ from .sampler import DistributedShardSampler, RandomSampler, SequentialSampler
 __all__ = [
     "get_dataset",
     "SyntheticDataset",
+    "SyntheticTextDataset",
+    "TokenFileDataset",
     "ImageFolderDataset",
     "DataLoader",
     "device_prefetch",

@@ -15,6 +15,13 @@ Names:
   - ``synthetic`` — deterministic random 224x224 images; the smoke-test /
     benchmarking dataset (BASELINE.json config #1 names "synthetic 224x224
     batch"), shaped like ImageNet but with zero host I/O cost.
+  - ``synthetic_text`` — deterministic Markov-chain token sequences for the
+    long-context LM path (beyond the reference, SURVEY.md §5.7); yields
+    host-shifted ``(inputs [S], targets [S])`` pairs.
+  - ``tokens`` — memory-mapped binary token file (``<root>/<split>.bin`` of
+    little-endian token ids + optional ``<root>/meta.json``), cut into
+    non-overlapping ``seq_len``-token windows; the real-data LM input with
+    zero decode cost (np.memmap reads pages on demand).
 
 TPU-native notes: samples are NHWC float32 (or uint8 pre-normalize), the
 layout XLA:TPU convolutions want; decode/augment runs on host CPU inside the
@@ -34,6 +41,8 @@ __all__ = [
     "sample_rng",
     "sample_crop_params",
     "SyntheticDataset",
+    "SyntheticTextDataset",
+    "TokenFileDataset",
     "ImageFolderDataset",
     "IMAGENET_MEAN",
     "IMAGENET_STD",
@@ -84,6 +93,101 @@ class SyntheticDataset:
         # class-dependent mean shift: learnable but not trivially separable
         img += 0.1 * ((label % 16) - 8) / 8.0
         return img, np.int64(label)
+
+
+class SyntheticTextDataset:
+    """Deterministic fake corpus: per-index Markov-chain token sequences.
+
+    Sequences follow a fixed random bigram transition table (seeded per
+    split), so next-token structure is learnable and short LM runs show a
+    decreasing loss — the text analog of :class:`SyntheticDataset`'s
+    class-dependent mean shift.  Each sample is reproducible from its index
+    alone (same property the distributed sharding premise needs).
+
+    Yields ``(inputs [seq_len], targets [seq_len])`` int32 pairs — targets
+    are the next tokens, shifted on the host because the shift crosses
+    sequence-shard boundaries (engine/sp_steps.py batch-layout contract).
+    """
+
+    def __init__(
+        self,
+        n_samples: int = 1024,
+        vocab_size: int = 512,
+        seq_len: int = 128,
+        split: str = "train",
+        seed: int = 0,
+    ):
+        self.n_samples = int(n_samples)
+        self.vocab_size = int(vocab_size)
+        self.seq_len = int(seq_len)
+        self._salt = (zlib.crc32(split.encode()) & 0xFFFF) ^ seed
+        # one shared transition table per split: row t -> 8 likely successors
+        table_rng = np.random.default_rng(self._salt)
+        self._successors = table_rng.integers(
+            0, self.vocab_size, (self.vocab_size, 8), dtype=np.int32
+        )
+
+    def __len__(self) -> int:
+        return self.n_samples
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        rng = np.random.default_rng(self._salt * 1_000_003 + idx)
+        toks = np.empty(self.seq_len + 1, dtype=np.int32)
+        toks[0] = rng.integers(0, self.vocab_size)
+        # 90% of steps follow the bigram table (learnable), 10% jump randomly
+        choices = rng.integers(0, 8, self.seq_len)
+        jumps = rng.random(self.seq_len) < 0.1
+        randoms = rng.integers(0, self.vocab_size, self.seq_len)
+        for t in range(self.seq_len):
+            toks[t + 1] = (
+                randoms[t] if jumps[t] else self._successors[toks[t], choices[t]]
+            )
+        return toks[:-1], toks[1:]
+
+
+class TokenFileDataset:
+    """``<root>/<split>.bin`` of little-endian token ids, windowed.
+
+    The LM analog of the ImageFolder path: a flat binary corpus (the format
+    nanoGPT-style preprocessors emit) memory-mapped and cut into
+    non-overlapping ``seq_len + 1``-token windows; window ``i`` yields
+    host-shifted ``(inputs, targets)``.  Optional ``<root>/meta.json`` keys:
+    ``dtype`` (default ``uint16``) and ``vocab_size`` (validated against the
+    config's ``n_classes`` by the caller if present).
+    """
+
+    def __init__(self, root: str, split: str, seq_len: int = 128):
+        import json
+
+        self.root = os.path.expanduser(root)
+        self.seq_len = int(seq_len)
+        path = os.path.join(self.root, f"{split}.bin")
+        if not os.path.isfile(path):
+            raise FileNotFoundError(f"token file not found: {path}")
+        dtype = "uint16"
+        meta_path = os.path.join(self.root, "meta.json")
+        self.vocab_size: Optional[int] = None
+        if os.path.isfile(meta_path):
+            with open(meta_path) as fp:
+                meta = json.load(fp)
+            dtype = meta.get("dtype", dtype)
+            self.vocab_size = meta.get("vocab_size")
+        self._tokens = np.memmap(path, dtype=np.dtype(dtype), mode="r")
+        self.n_windows = (len(self._tokens) - 1) // self.seq_len
+        if self.n_windows <= 0:
+            raise ValueError(
+                f"{path}: {len(self._tokens)} tokens < one {self.seq_len + 1}-token window"
+            )
+
+    def __len__(self) -> int:
+        return self.n_windows
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        start = int(idx) * self.seq_len
+        window = np.asarray(
+            self._tokens[start : start + self.seq_len + 1], dtype=np.int32
+        )
+        return window[:-1], window[1:]
 
 
 def sample_rng(seed: int, epoch: int, idx: int) -> np.random.Generator:
@@ -274,13 +378,15 @@ def get_dataset(
     n_classes: Optional[int] = None,
     image_size: int = 224,
     n_samples: Optional[int] = None,
+    seq_len: Optional[int] = None,
 ):
     """Dataset factory (reference: train_distributed.py:171-181).
 
-    ``n_classes`` / ``image_size`` / ``n_samples`` parameterize the synthetic
-    dataset (the engine forwards optional ``dataset.image_size`` /
-    ``dataset.n_samples`` config keys — additive, unknown to the reference
-    schema but ignored there).
+    ``n_classes`` / ``image_size`` / ``n_samples`` / ``seq_len`` parameterize
+    the synthetic + token datasets (the engine forwards the optional
+    ``dataset.image_size`` / ``dataset.n_samples`` / ``dataset.seq_len``
+    config keys — additive, unknown to the reference schema).  For LM
+    datasets ``n_classes`` is the vocabulary size.
     """
     name = name.lower()
     if name in ("synthetic", "fake", "fake_imagenet"):
@@ -293,4 +399,22 @@ def get_dataset(
         )
     if name == "imagenet":
         return ImageFolderDataset(root, split, image_size=image_size)
-    raise KeyError(f"unknown dataset '{name}' (have: imagenet, synthetic)")
+    if name in ("synthetic_text", "fake_text"):
+        n = n_samples if n_samples else (4_096 if split == "train" else 512)
+        return SyntheticTextDataset(
+            n_samples=n,
+            vocab_size=n_classes or 512,
+            seq_len=seq_len or 128,
+            split=split,
+        )
+    if name in ("tokens", "tokenbin"):
+        ds = TokenFileDataset(root, split, seq_len=seq_len or 128)
+        if ds.vocab_size is not None and n_classes and ds.vocab_size > n_classes:
+            raise ValueError(
+                f"{root}/meta.json vocab_size {ds.vocab_size} exceeds "
+                f"dataset.n_classes {n_classes}"
+            )
+        return ds
+    raise KeyError(
+        f"unknown dataset '{name}' (have: imagenet, synthetic, synthetic_text, tokens)"
+    )

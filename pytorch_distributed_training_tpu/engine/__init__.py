@@ -5,7 +5,7 @@ the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
 from .profiling import TraceProfiler
 from .runner import Runner
-from .sp_steps import build_lm_train_step
+from .sp_steps import build_lm_eval_step, build_lm_train_step
 from .steps import TrainState, build_eval_step, build_train_step, init_train_state
 from .tp_steps import build_tp_lm_train_step
 
@@ -16,6 +16,7 @@ __all__ = [
     "build_train_step",
     "build_eval_step",
     "build_lm_train_step",
+    "build_lm_eval_step",
     "build_tp_lm_train_step",
     "init_train_state",
 ]

@@ -52,18 +52,23 @@ from ..data import (
 from ..metrics import AverageMeter
 from ..models import get_model
 from ..optimizers import get_optimizer
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from ..parallel import (
     DATA_AXIS,
     batch_sharding,
     initialize_distributed,
     make_mesh,
+    make_sp_mesh,
     replicated_sharding,
 )
+from ..parallel.sequence import SEQUENCE_AXIS
 from ..schedulers import get_scheduler
 from ..utils import make_deterministic, make_iter_dataloader
 from .checkpoint import Checkpointer
 from .profiling import TraceProfiler
-from .steps import build_eval_step, build_train_step, init_train_state
+from .sp_steps import build_lm_eval_step, build_lm_train_step
+from .steps import TrainState, build_eval_step, build_train_step, init_train_state
 
 __all__ = ["Runner"]
 
@@ -146,6 +151,7 @@ class Runner:
             n_classes=cfg["dataset"]["n_classes"],
             image_size=cfg["dataset"].get("image_size", 224),
             n_samples=cfg["dataset"].get("n_samples"),
+            seq_len=cfg["dataset"].get("seq_len"),
         )
         train_dataset = get_dataset(
             cfg["dataset"]["name"], cfg["dataset"]["root"], split="train", **ds_kwargs
@@ -158,13 +164,59 @@ class Runner:
             "float32": jnp.float32,
             "bfloat16": jnp.bfloat16,
         }[train_cfg.get("dtype", "float32")]
-        sync_bn = bool(train_cfg["sync_bn"]) and self.distributed
-        self.model = get_model(
-            model_name=cfg["model"]["name"],
-            num_classes=cfg["dataset"]["n_classes"],
-            axis_name=DATA_AXIS if sync_bn else None,
-            dtype=self.compute_dtype,
+        # Model section: ``name`` is the reference's only key (:183-186);
+        # extra keys are architecture hyperparameters forwarded to the zoo
+        # (additive — e.g. embed_dim/depth/num_heads for TransformerLM).
+        model_cfg = dict(cfg["model"])
+        model_name = model_cfg.pop("name")
+        # The long-context LM task (beyond the reference, SURVEY.md §5.7):
+        # first-class from the config surface — ``model.name:
+        # TransformerLM`` + an LM dataset + optional
+        # ``training.sequence_parallelism`` (ring/Ulysses over a sequence
+        # mesh axis, parallel.sequence).
+        self.is_lm = model_name.lower() == "transformerlm"
+        sync_bn = (
+            bool(train_cfg["sync_bn"]) and self.distributed and not self.is_lm
         )
+        self.seq_par = int(train_cfg.get("sequence_parallelism", 1))
+        if self.seq_par > 1 and not self.is_lm:
+            raise ValueError(
+                "training.sequence_parallelism requires model.name: TransformerLM"
+            )
+        if self.is_lm:
+            if self.seq_par < 1 or jax.local_device_count() % self.seq_par != 0:
+                # the host-batch layout (and make_array_from_process_local_data)
+                # assumes each host holds whole sequence-shard groups
+                raise ValueError(
+                    f"training.sequence_parallelism ({self.seq_par}) must divide "
+                    f"the local device count ({jax.local_device_count()})"
+                )
+            sample_inp, _ = train_dataset[0]
+            self.seq_len = int(sample_inp.shape[0])
+            if self.seq_len % self.seq_par != 0:
+                raise ValueError(
+                    f"dataset.seq_len ({self.seq_len}) must be divisible by "
+                    f"training.sequence_parallelism ({self.seq_par})"
+                )
+            model_cfg.setdefault("max_len", self.seq_len)
+            if self.seq_par > 1:
+                model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
+            self.model = get_model(
+                model_name,
+                num_classes=cfg["dataset"]["n_classes"],
+                dtype=self.compute_dtype,
+                **model_cfg,
+            )
+        else:
+            # reference behavior: only ``model.name`` is read for the image
+            # zoo — extra keys stay ignored (forwarding them would crash
+            # ResNet/ViT constructors on e.g. annotation-only keys)
+            self.model = get_model(
+                model_name,
+                num_classes=cfg["dataset"]["n_classes"],
+                axis_name=DATA_AXIS if sync_bn else None,
+                dtype=self.compute_dtype,
+            )
 
         batch_size = train_cfg["batch_size"]
         n_workers = train_cfg["num_workers"]
@@ -181,21 +233,26 @@ class Runner:
             raise ValueError(
                 f"training.batch_division must be 'local' or 'world', got {division!r}"
             )
+        # Batch rows shard over the DATA axis only; under sequence
+        # parallelism each group of seq_par devices holds one batch shard,
+        # so the division unit is a data shard, not a device.
+        units_local = local_devices // self.seq_par if self.is_lm else local_devices
+        units_world = self.world_size // self.seq_par if self.is_lm else self.world_size
         if self.distributed:
-            divisor = self.world_size if division == "world" else local_devices
-            per_device_batch = batch_size // divisor
-            if per_device_batch == 0:
+            divisor = units_world if division == "world" else units_local
+            per_device_batch = batch_size // max(divisor, 1)
+            if per_device_batch == 0 or divisor == 0:
                 raise ValueError(
-                    f"batch_size {batch_size} < {division} device count {divisor}"
+                    f"batch_size {batch_size} < {division} batch-shard count {divisor}"
                 )
             if division == "world" and batch_size % divisor != 0:
                 # the mode's whole contract is "cfg batch_size IS the global
                 # batch" — a silent floor would break it, so fail loudly
                 raise ValueError(
                     f"batch_division: world requires batch_size ({batch_size}) "
-                    f"divisible by the world device count ({divisor})"
+                    f"divisible by the world batch-shard count ({divisor})"
                 )
-            host_batch = per_device_batch * local_devices
+            host_batch = per_device_batch * units_local
         else:
             host_batch = batch_size
         # One controller per host: cfg num_workers = decode threads per host
@@ -263,23 +320,44 @@ class Runner:
         )
 
         # --- mesh + compiled steps + replicated state -----------------------
-        self.mesh = make_mesh()
-        sample_img, _ = train_dataset[0]
-        sample = jnp.zeros((1,) + tuple(sample_img.shape), jnp.float32)
-        state = init_train_state(
-            self.model, self.optimizer, jax.random.PRNGKey(seed), sample
-        )
-        self.state = jax.device_put(state, replicated_sharding(self.mesh))
-        self.train_step = build_train_step(
-            self.model,
-            self.optimizer,
-            self.scheduler.lr_fn,
-            self.mesh,
-            sync_bn=sync_bn,
-        )
-        self.eval_step = build_eval_step(self.model, self.mesh)
-        self._img_sharding = batch_sharding(self.mesh, ndim=4)
-        self._label_sharding = batch_sharding(self.mesh, ndim=1)
+        if self.is_lm:
+            # (data, sequence) mesh; with sequence_parallelism == 1 the
+            # sequence axis is trivial and this is plain DP over tokens
+            self.mesh = make_sp_mesh(self.seq_par)
+            sample = jnp.zeros((1, self.seq_len), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            state = TrainState(
+                params=params,
+                batch_stats={},
+                opt_state=self.optimizer.init(params),
+            )
+            self.state = jax.device_put(state, replicated_sharding(self.mesh))
+            self.train_step = build_lm_train_step(
+                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh
+            )
+            self.eval_step = build_lm_eval_step(self.model, self.mesh)
+            # tokens/targets are [batch, seq], sharded over BOTH mesh axes
+            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, SEQUENCE_AXIS))
+            self._img_sharding = tok_sharding
+            self._label_sharding = tok_sharding
+        else:
+            self.mesh = make_mesh()
+            sample_img, _ = train_dataset[0]
+            sample = jnp.zeros((1,) + tuple(sample_img.shape), jnp.float32)
+            state = init_train_state(
+                self.model, self.optimizer, jax.random.PRNGKey(seed), sample
+            )
+            self.state = jax.device_put(state, replicated_sharding(self.mesh))
+            self.train_step = build_train_step(
+                self.model,
+                self.optimizer,
+                self.scheduler.lr_fn,
+                self.mesh,
+                sync_bn=sync_bn,
+            )
+            self.eval_step = build_eval_step(self.model, self.mesh)
+            self._img_sharding = batch_sharding(self.mesh, ndim=4)
+            self._label_sharding = batch_sharding(self.mesh, ndim=1)
         self.global_batch = host_batch * n_hosts
         self._tput_t0 = time.monotonic()
         self._tput_iters = 0
@@ -358,8 +436,9 @@ class Runner:
     # ------------------------------------------------------------- hot loop
     def _put_batch(self, img: np.ndarray, label: np.ndarray):
         """Host shard -> globally-sharded device arrays (the reference's
-        pinned-memory ``non_blocking`` H2D copies, :272-273)."""
-        img = np.asarray(img, dtype=np.float32)
+        pinned-memory ``non_blocking`` H2D copies, :272-273).  For the LM
+        task both halves are int32 token grids (inputs, next-token targets)."""
+        img = np.asarray(img, dtype=np.int32 if self.is_lm else np.float32)
         label = np.asarray(label, dtype=np.int32)
         g_img = jax.make_array_from_process_local_data(self._img_sharding, img)
         g_label = jax.make_array_from_process_local_data(self._label_sharding, label)

@@ -36,7 +36,7 @@ from ..parallel.mesh import DATA_AXIS
 from ..parallel.sequence import SEQUENCE_AXIS
 from .steps import TrainState
 
-__all__ = ["build_lm_train_step", "lm_loss_local"]
+__all__ = ["build_lm_train_step", "build_lm_eval_step", "lm_loss_local"]
 
 
 def lm_loss_local(logits, labels, global_tokens: int):
@@ -113,3 +113,52 @@ def build_lm_train_step(
         )
 
     return train_step
+
+
+def build_lm_eval_step(
+    model,
+    mesh: Mesh,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQUENCE_AXIS,
+):
+    """Compile the distributed LM validation step.
+
+    Mirrors the classifier eval contract (engine/steps.py, reference
+    :309-321): returns replicated ``(loss, acc1, acc5)`` — mean CE per token
+    and next-token top-1/top-5 accuracy, ``psum``-weighted over the (data,
+    sequence) axes so every shard's tokens count once.  Same signature as
+    the classifier eval step, so ``Runner.validate`` drives either.
+    """
+    from ..metrics import accuracy
+
+    axes = (data_axis, seq_axis)
+    n_shards = mesh.shape[data_axis] * mesh.shape[seq_axis]
+
+    def body(params, tokens, labels):
+        logits = model.apply({"params": params}, tokens)
+        vocab = logits.shape[-1]
+        flat_logits = logits.reshape(-1, vocab)
+        flat_labels = labels.reshape(-1)
+        global_tokens = flat_labels.size * n_shards
+        loss = jax.lax.psum(
+            lm_loss_local(logits, labels, global_tokens), axes
+        )
+        acc1, acc5 = accuracy(flat_logits, flat_labels, topk=(1, 5))
+        # equal local token counts -> psum/n == the global token mean
+        acc1, acc5 = jax.lax.pmean((acc1, acc5), axes)
+        return loss, acc1, acc5
+
+    rep = P()
+    tok_spec = P(data_axis, seq_axis)
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(rep, tok_spec, tok_spec),
+        out_specs=(rep, rep, rep),
+    )
+
+    @jax.jit
+    def eval_step(state: TrainState, tokens, labels):
+        return sharded(state.params, tokens, labels)
+
+    return eval_step

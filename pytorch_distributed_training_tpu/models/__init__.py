@@ -6,8 +6,9 @@ num_classes) -> model``.  Case-insensitive on the name; the reference configs
 use ``ResNet50`` (config/ResNet50.yml:31).
 
 Families: the reference's ResNet-18/34/50/101/152 (README.md:7-13) plus a
-ViT family (ViT-Ti16/S16/B16) added beyond the reference — the config
-surface only pins ``model.name``, so new names slot straight in.
+ViT family (ViT-Ti16/S16/B16) and a decoder-only ``TransformerLM`` (the
+long-context / sequence-parallel model) added beyond the reference — the
+config surface only pins ``model.name``, so new names slot straight in.
 """
 from __future__ import annotations
 
@@ -16,6 +17,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 
 from .resnet import RESNET_CONFIGS, BasicBlock, Bottleneck, ResNet
+from .transformer_lm import TransformerLM
 from .vit import VIT_CONFIGS, ViT
 
 __all__ = [
@@ -25,14 +27,16 @@ __all__ = [
     "BasicBlock",
     "Bottleneck",
     "ViT",
+    "TransformerLM",
 ]
 
 _CANONICAL = {name.lower(): name for name in RESNET_CONFIGS}
 _CANONICAL.update({name.lower(): name for name in VIT_CONFIGS})
+_CANONICAL["transformerlm"] = "TransformerLM"
 
 
 def list_models():
-    return sorted(RESNET_CONFIGS) + sorted(VIT_CONFIGS)
+    return sorted(RESNET_CONFIGS) + sorted(VIT_CONFIGS) + ["TransformerLM"]
 
 
 def get_model(
@@ -40,19 +44,29 @@ def get_model(
     num_classes: int,
     axis_name: Optional[str] = None,
     dtype: Any = jnp.float32,
+    **kwargs,
 ):
     """Build a model by zoo name (reference: train_distributed.py:183-186).
 
-    Extra TPU-native knobs beyond the reference signature (both keyword-only
-    in spirit; the engine wires them from config):
+    Extra TPU-native knobs beyond the reference signature (keyword-only in
+    spirit; the engine wires them from config):
       axis_name: mesh axis for SyncBN (``sync_bn: True`` => the data axis;
         models without batch statistics accept and ignore it).
       dtype: compute dtype (bf16 mixed precision).
+      **kwargs: architecture hyperparameters forwarded verbatim to the
+        module — the engine passes any extra keys of the ``model:`` config
+        section here (e.g. ``embed_dim/depth/num_heads/max_len/seq_axis``
+        for ``TransformerLM``).
+
+    For ``TransformerLM`` the reference's ``num_classes`` slot is the
+    vocabulary size (``dataset.n_classes`` in the config).
     """
     key = model_name.lower()
     if key not in _CANONICAL:
         raise KeyError(f"unknown model '{model_name}' (have: {list_models()})")
     name = _CANONICAL[key]
+    if name == "TransformerLM":
+        return TransformerLM(vocab_size=num_classes, dtype=dtype, **kwargs)
     if name in RESNET_CONFIGS:
         block_cls, stage_sizes = RESNET_CONFIGS[name]
         return ResNet(
@@ -61,6 +75,7 @@ def get_model(
             num_classes=num_classes,
             axis_name=axis_name,
             dtype=dtype,
+            **kwargs,
         )
     patch, embed, depth, heads = VIT_CONFIGS[name]
     return ViT(
@@ -71,4 +86,5 @@ def get_model(
         num_heads=heads,
         axis_name=axis_name,
         dtype=dtype,
+        **kwargs,
     )

@@ -59,7 +59,7 @@ class TransformerLM(nn.Module):
     """Causal LM over integer tokens ``[B, S(_local)] -> logits [B, S, V]``."""
 
     vocab_size: int
-    max_len: int
+    max_len: int = 1024
     embed_dim: int = 256
     depth: int = 4
     num_heads: int = 8

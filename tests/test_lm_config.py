@@ -1,0 +1,193 @@
+"""Long-context LM drivable from the config surface (VERDICT item #10).
+
+"First-class" sequence parallelism must mean reachable by a user of the
+reference-compatible entry points: ``model.name: TransformerLM`` + an LM
+dataset + ``training.sequence_parallelism`` in the YAML, driven end to end
+through the same Runner that drives ResNet (same flags, same log/TB tags).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.data import (
+    SyntheticTextDataset,
+    TokenFileDataset,
+    get_dataset,
+)
+from pytorch_distributed_training_tpu.engine import Runner
+from pytorch_distributed_training_tpu.models import TransformerLM, get_model
+
+
+class _FakeTB:
+    def __init__(self):
+        self.scalars = []
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), int(step)))
+
+
+# ------------------------------------------------------------- factory/data
+def test_get_model_transformer_lm_kwargs():
+    m = get_model(
+        "TransformerLM", num_classes=64, embed_dim=32, depth=2, num_heads=4,
+        max_len=128,
+    )
+    assert isinstance(m, TransformerLM)
+    assert m.vocab_size == 64 and m.embed_dim == 32 and m.max_len == 128
+
+
+def test_synthetic_text_deterministic_and_shifted():
+    ds = get_dataset("synthetic_text", "/unused", "train", n_classes=64, seq_len=32)
+    assert isinstance(ds, SyntheticTextDataset)
+    inp1, tgt1 = ds[3]
+    inp2, tgt2 = ds[3]
+    np.testing.assert_array_equal(inp1, inp2)  # reproducible from index alone
+    np.testing.assert_array_equal(inp1[1:], tgt1[:-1])  # host-shifted pair
+    assert inp1.shape == (32,) and inp1.dtype == np.int32
+    assert inp1.min() >= 0 and inp1.max() < 64
+    # train/val streams are disjoint (different split salt)
+    val = get_dataset("synthetic_text", "/unused", "val", n_classes=64, seq_len=32)
+    assert not np.array_equal(val[3][0], inp1)
+
+
+def test_synthetic_text_has_learnable_structure():
+    """~90% of transitions follow the split's bigram table — next-token
+    structure a short LM run can pick up."""
+    ds = SyntheticTextDataset(n_samples=8, vocab_size=64, seq_len=256, split="train")
+    hits = total = 0
+    for i in range(8):
+        inp, tgt = ds[i]
+        for t in range(len(inp)):
+            hits += tgt[t] in ds._successors[inp[t]]
+            total += 1
+    assert hits / total > 0.8
+
+
+def test_token_file_dataset(tmp_path):
+    vocab = 100
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, vocab, 1000, dtype=np.uint16)
+    corpus.tofile(tmp_path / "train.bin")
+    (tmp_path / "meta.json").write_text(
+        json.dumps({"dtype": "uint16", "vocab_size": vocab})
+    )
+    ds = get_dataset("tokens", str(tmp_path), "train", n_classes=128, seq_len=64)
+    assert isinstance(ds, TokenFileDataset)
+    assert len(ds) == (1000 - 1) // 64
+    inp, tgt = ds[2]
+    np.testing.assert_array_equal(inp, corpus[128:192].astype(np.int32))
+    np.testing.assert_array_equal(tgt, corpus[129:193].astype(np.int32))
+    # meta vocab larger than configured n_classes is a hard error
+    with pytest.raises(ValueError):
+        get_dataset("tokens", str(tmp_path), "train", n_classes=50, seq_len=64)
+    with pytest.raises(FileNotFoundError):
+        get_dataset("tokens", str(tmp_path), "val", n_classes=128, seq_len=64)
+
+
+# --------------------------------------------------------- Runner end-to-end
+def _lm_cfg(seq_par: int, dataset: dict) -> dict:
+    return {
+        "dataset": dataset,
+        "training": {
+            "optimizer": {
+                "name": "SGD",
+                "lr": 0.1,
+                "weight_decay": 1.0e-4,
+                "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": 6,
+            "print_interval": 2,
+            "val_interval": 3,
+            "batch_size": 8,
+            "num_workers": 2,
+            "sync_bn": False,
+            "sequence_parallelism": seq_par,
+        },
+        "validation": {"batch_size": 8, "num_workers": 2},
+        "model": {
+            "name": "TransformerLM",
+            "embed_dim": 32,
+            "depth": 2,
+            "num_heads": 4,
+        },
+    }
+
+
+def _run(cfg):
+    tb = _FakeTB()
+    runner = Runner(
+        num_nodes=1,
+        rank=0,
+        seed=1029,
+        dist_url="tcp://127.0.0.1:9941",
+        dist_backend="tpu",
+        multiprocessing=False,
+        logger_queue=None,
+        global_cfg=cfg,
+        tb_writer_constructor=lambda: tb,
+    )
+    runner()
+    return runner, tb
+
+
+def test_runner_lm_ring_sp_end_to_end():
+    """synthetic_text + sequence_parallelism: 4 on the 8-device mesh
+    (DPx2 x SPx4 ring attention), through the reference Runner flow."""
+    cfg = _lm_cfg(
+        4,
+        {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 96,
+        },
+    )
+    runner, tb = _run(cfg)
+    assert runner.is_lm and runner.seq_par == 4
+    assert runner.mesh.shape == {"data": 2, "sequence": 4}
+    assert runner.iter == 6
+    tags = {t for t, _, _ in tb.scalars}
+    # the reference's exact five tag families drive the LM task too
+    assert {"loss/train", "lr_group/0", "eval/Acc@1", "eval/Acc@5", "eval/loss"} <= tags
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert np.isfinite(losses).all()
+    accs = [v for t, v, _ in tb.scalars if t == "eval/Acc@1"]
+    assert all(0.0 <= a <= 100.0 for a in accs)
+
+
+def test_runner_lm_token_file_dp_end_to_end(tmp_path):
+    """tokens (memory-mapped corpus) + plain DP (sequence_parallelism: 1)."""
+    vocab = 64
+    rng = np.random.default_rng(1)
+    for split, n in (("train", 4000), ("val", 600)):
+        rng.integers(0, vocab, n, dtype=np.uint16).tofile(tmp_path / f"{split}.bin")
+    (tmp_path / "meta.json").write_text(json.dumps({"dtype": "uint16"}))
+    cfg = _lm_cfg(
+        1,
+        {"name": "tokens", "root": str(tmp_path), "n_classes": vocab, "seq_len": 32},
+    )
+    runner, tb = _run(cfg)
+    assert runner.is_lm and runner.mesh.shape == {"data": 8, "sequence": 1}
+    assert runner.iter == 6
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert np.isfinite(losses).all()
+
+
+def test_sequence_parallelism_requires_lm(tmp_path):
+    cfg = _lm_cfg(
+        2,
+        {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 64,
+        },
+    )
+    cfg["model"] = {"name": "ResNet18"}
+    with pytest.raises(ValueError, match="sequence_parallelism"):
+        _run(cfg)

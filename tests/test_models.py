@@ -35,7 +35,9 @@ def test_param_count_parity(name):
 
 
 def test_all_names_resolve():
-    assert set(list_models()) == set(TORCHVISION_PARAM_COUNTS) | VIT_NAMES
+    assert set(list_models()) == (
+        set(TORCHVISION_PARAM_COUNTS) | VIT_NAMES | {"TransformerLM"}
+    )
     for name in list_models():
         get_model(name, num_classes=10)
     get_model("resnet50", num_classes=10)  # case-insensitive
