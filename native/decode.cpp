@@ -152,10 +152,15 @@ Taps make_taps(int out_len, double c0, double clen, int in_len) {
 
 // Resample the crop box of an RGB8 image to out_size x out_size, then
 // flip/normalize into `out` (float32 HWC): out = pix * scale[c] + bias[c].
+// When `out_u8` is non-null the pass instead writes round-clamped uint8
+// (no normalization) — the transfer-optimized mode where the (x/255-mean)/std
+// affine runs on the accelerator and the host ships 4x fewer bytes; the
+// quantization matches the PIL reference path, which also materializes
+// uint8 after resampling.
 void resample_normalize(const uint8_t* src, int w, int h, double bx, double by,
                         double bw, double bh, int out_size, bool flip,
                         const float* scale, const float* bias, float* out,
-                        std::vector<float>& tmp) {
+                        uint8_t* out_u8, std::vector<float>& tmp) {
   Taps tx = make_taps(out_size, bx, bw, w);
   Taps ty = make_taps(out_size, by, bh, h);
   // Horizontal pass over only the rows the vertical pass can touch.
@@ -185,11 +190,10 @@ void resample_normalize(const uint8_t* src, int w, int h, double bx, double by,
       trow[xo * 3 + 2] = b;
     }
   }
-  // Vertical pass + flip + fused normalize.
+  // Vertical pass + flip + fused normalize (or uint8 quantize).
   for (int yo = 0; yo < out_size; ++yo) {
     const float* wrow = ty.weights.data() + static_cast<size_t>(yo) * ty.max_count;
     int s = ty.start[yo], c = ty.count[yo];
-    float* orow = out + static_cast<size_t>(yo) * out_size * 3;
     for (int xo = 0; xo < out_size; ++xo) {
       float r = 0, g = 0, b = 0;
       for (int k = 0; k < c; ++k) {
@@ -201,32 +205,41 @@ void resample_normalize(const uint8_t* src, int w, int h, double bx, double by,
         b += wgt * p[2];
       }
       int xdst = flip ? (out_size - 1 - xo) : xo;
-      float* o = orow + static_cast<size_t>(xdst) * 3;
-      o[0] = r * scale[0] + bias[0];
-      o[1] = g * scale[1] + bias[1];
-      o[2] = b * scale[2] + bias[2];
+      if (out_u8 != nullptr) {
+        uint8_t* o = out_u8 +
+                     (static_cast<size_t>(yo) * out_size + xdst) * 3;
+        o[0] = static_cast<uint8_t>(
+            std::min(255.0f, std::max(0.0f, std::nearbyint(r))));
+        o[1] = static_cast<uint8_t>(
+            std::min(255.0f, std::max(0.0f, std::nearbyint(g))));
+        o[2] = static_cast<uint8_t>(
+            std::min(255.0f, std::max(0.0f, std::nearbyint(b))));
+      } else {
+        float* o = out + (static_cast<size_t>(yo) * out_size + xdst) * 3;
+        o[0] = r * scale[0] + bias[0];
+        o[1] = g * scale[1] + bias[1];
+        o[2] = b * scale[2] + bias[2];
+      }
     }
   }
 }
 
-}  // namespace
-
-extern "C" {
-
-// Decode `n` JPEGs into out[n, out_size, out_size, 3] float32.
+// Decode `n` JPEGs into out[n, out_size, out_size, 3] (float32 normalized
+// via `out`, or raw uint8 via `out_u8` — exactly one must be non-null).
 //   paths:  n C strings
 //   boxes:  [n,4] float64 crop boxes (x, y, w, h) in original-image coords
 //   flips:  [n] uint8 horizontal-flip flags
-//   scale/bias: [3] fused normalization out = pix*scale + bias
+//   scale/bias: [3] fused normalization out = pix*scale + bias (f32 mode)
 //   dct_denom: 1 (exact) or 2/4/8 = DCT-domain pre-scale (crop coords are
 //              divided accordingly); 0 = auto-pick largest denom that keeps
 //              the decoded crop >= out_size on both axes.
 //   status: [n] int32, 0 = ok, 1 = decode failed (caller should fall back)
 //   n_threads: <=0 selects hardware_concurrency (capped at 32)
-void pdt_decode_jpeg_batch(const char** paths, const double* boxes,
-                           const uint8_t* flips, long n, int out_size,
-                           const float* scale, const float* bias, float* out,
-                           int dct_denom, int n_threads, int32_t* status) {
+void pdt_decode_jpeg_batch_impl(const char** paths, const double* boxes,
+                                const uint8_t* flips, long n, int out_size,
+                                const float* scale, const float* bias,
+                                float* out, uint8_t* out_u8, int dct_denom,
+                                int n_threads, int32_t* status) {
   if (n_threads <= 0) {
     n_threads = static_cast<int>(
         std::min(32u, std::max(1u, std::thread::hardware_concurrency())));
@@ -271,10 +284,11 @@ void pdt_decode_jpeg_batch(const char** paths, const double* boxes,
       by = std::max(0.0, std::min(by, static_cast<double>(h)));
       bw = std::max(1e-6, std::min(bw, w - bx));
       bh = std::max(1e-6, std::min(bh, h - by));
+      size_t off = static_cast<size_t>(i) * out_size * out_size * 3;
       resample_normalize(pixels.data(), w, h, bx, by, bw, bh, out_size,
                          flips[i] != 0, scale, bias,
-                         out + static_cast<size_t>(i) * out_size * out_size * 3,
-                         tmp);
+                         out != nullptr ? out + off : nullptr,
+                         out_u8 != nullptr ? out_u8 + off : nullptr, tmp);
       status[i] = 0;
     }
   };
@@ -286,6 +300,29 @@ void pdt_decode_jpeg_batch(const char** paths, const double* boxes,
   threads.reserve(n_threads);
   for (int t = 0; t < n_threads; ++t) threads.emplace_back(work);
   for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+void pdt_decode_jpeg_batch(const char** paths, const double* boxes,
+                           const uint8_t* flips, long n, int out_size,
+                           const float* scale, const float* bias, float* out,
+                           int dct_denom, int n_threads, int32_t* status) {
+  pdt_decode_jpeg_batch_impl(paths, boxes, flips, n, out_size, scale, bias,
+                             out, nullptr, dct_denom, n_threads, status);
+}
+
+// uint8 output variant: decode/crop/resample/flip only — the normalization
+// affine runs on the accelerator (data/loader.py output_dtype="uint8").
+void pdt_decode_jpeg_batch_u8(const char** paths, const double* boxes,
+                              const uint8_t* flips, long n, int out_size,
+                              uint8_t* out, int dct_denom, int n_threads,
+                              int32_t* status) {
+  pdt_decode_jpeg_batch_impl(paths, boxes, flips, n, out_size, nullptr,
+                             nullptr, nullptr, out, dct_denom, n_threads,
+                             status);
 }
 
 }  // extern "C"

@@ -63,9 +63,19 @@ class DataLoader:
         prefetch_batches: int = 2,
         worker_mode: str = "auto",
         dct_denom: int = 1,
+        output_dtype: str = "float32",
     ):
+        """``output_dtype``: ``"float32"`` (default) yields host-normalized
+        batches — reference parity, the normalization runs on the host;
+        ``"uint8"`` yields raw uint8 pixels so the ``(x/255 - mean)/std``
+        affine runs on the accelerator instead (``engine.steps`` input_norm)
+        and host->device transfer shrinks 4x."""
         if worker_mode not in _MODES:
             raise ValueError(f"worker_mode must be one of {_MODES}, got {worker_mode!r}")
+        if output_dtype not in ("float32", "uint8"):
+            raise ValueError(
+                f"output_dtype must be 'float32' or 'uint8', got {output_dtype!r}"
+            )
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.sampler = sampler
@@ -73,9 +83,15 @@ class DataLoader:
         self.drop_last = bool(drop_last)
         self.prefetch_batches = max(1, int(prefetch_batches))
         self.dct_denom = int(dct_denom)
+        self.output_dtype = output_dtype
         self.seed = int(getattr(sampler, "seed", 0))
         self._pool = None  # lazily-created ProcessLoaderPool
         self.worker_mode = self._resolve_mode(worker_mode)
+        if output_dtype == "uint8" and getattr(dataset, "norm_mean", None) is None:
+            raise ValueError(
+                "output_dtype='uint8' requires a dataset with uint8 samples "
+                "and norm_mean/norm_std (device-side normalization constants)"
+            )
 
     def _resolve_mode(self, mode: str) -> str:
         if mode != "auto":
@@ -141,7 +157,7 @@ class DataLoader:
         else:
             samples = [fetch(i) for i in indices]
         imgs = np.stack([s[0] for s in samples])
-        if imgs.dtype == np.uint8:
+        if imgs.dtype == np.uint8 and self.output_dtype == "float32":
             imgs = self._normalize_u8(imgs)
         labels = np.asarray([s[1] for s in samples], dtype=np.int64)
         return imgs, labels
@@ -159,13 +175,14 @@ class DataLoader:
         labels = np.asarray([t[1] for t in tasks], dtype=np.int64)
         boxes = np.asarray([t[2][:4] for t in tasks], dtype=np.float64)
         flips = np.asarray([t[2][4] for t in tasks], dtype=np.uint8)
+        raw_u8 = self.output_dtype == "uint8"
         out, status = decode_jpeg_batch(
             paths,
             boxes,
             flips,
             ds.image_size,
-            ds.norm_mean,
-            ds.norm_std,
+            None if raw_u8 else ds.norm_mean,
+            None if raw_u8 else ds.norm_std,
             dct_denom=self.dct_denom,
             n_threads=self.num_workers if self.num_workers > 0 else 1,
         )
@@ -176,7 +193,10 @@ class DataLoader:
 
             for r in np.nonzero(status)[0]:
                 arr = ds.decode_with_params(int(indices[r]), tasks[r][2])
-                out[r] = normalize_batch(arr[None], ds.norm_mean, ds.norm_std)[0]
+                if raw_u8:
+                    out[r] = arr
+                else:
+                    out[r] = normalize_batch(arr[None], ds.norm_mean, ds.norm_std)[0]
         return out, labels
 
     # ------------------------------------------------------------ iteration
@@ -210,7 +230,7 @@ class DataLoader:
             )
 
         def postprocess(slot_view: np.ndarray, label_view: np.ndarray):
-            if slot_view.dtype == np.uint8:
+            if slot_view.dtype == np.uint8 and self.output_dtype == "float32":
                 imgs = self._normalize_u8(slot_view)  # writes a fresh array
             else:
                 imgs = np.array(slot_view)  # copy out: slot is recycled next

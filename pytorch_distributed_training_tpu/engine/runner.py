@@ -294,6 +294,23 @@ class Runner:
         # "auto" picks the native C++ batch decoder for JPEG folder datasets,
         # threads otherwise; "process"/"thread" force a backend (loader.py).
         worker_mode = train_cfg.get("worker_mode", "auto")
+        # Additive key ``training.device_normalize``: ship raw uint8 pixels
+        # and run the (x/255 - mean)/std affine in-graph on the accelerator —
+        # 4x less host->device traffic and one fewer host pass per image.
+        # Default False = host-side normalization (reference parity).
+        self.device_normalize = bool(train_cfg.get("device_normalize", False))
+        norm_mean = getattr(train_dataset, "norm_mean", None)
+        if self.device_normalize and (self.is_lm or norm_mean is None):
+            raise ValueError(
+                "training.device_normalize requires an image dataset with "
+                "norm_mean/norm_std (e.g. imagenet)"
+            )
+        output_dtype = "uint8" if self.device_normalize else "float32"
+        self._input_norm = (
+            (train_dataset.norm_mean, train_dataset.norm_std)
+            if self.device_normalize
+            else None
+        )
         self.train_loader = train_loader = DataLoader(
             train_dataset,
             batch_size=host_batch,
@@ -301,6 +318,7 @@ class Runner:
             num_workers=n_workers,
             drop_last=True,
             worker_mode=worker_mode,
+            output_dtype=output_dtype,
         )
         # Parity: val loader reuses TRAINING batch/workers (:235-241).
         self.val_loader = DataLoader(
@@ -310,6 +328,7 @@ class Runner:
             num_workers=n_workers,
             drop_last=False,
             worker_mode=worker_mode,
+            output_dtype=output_dtype,
         )
         self.logger.info(
             "Load dataset done\nTraining: %d imgs, %d batchs\nEval: %d imgs, %d batchs",
@@ -354,8 +373,11 @@ class Runner:
                 self.scheduler.lr_fn,
                 self.mesh,
                 sync_bn=sync_bn,
+                input_norm=self._input_norm,
             )
-            self.eval_step = build_eval_step(self.model, self.mesh)
+            self.eval_step = build_eval_step(
+                self.model, self.mesh, input_norm=self._input_norm
+            )
             self._img_sharding = batch_sharding(self.mesh, ndim=4)
             self._label_sharding = batch_sharding(self.mesh, ndim=1)
         self.global_batch = host_batch * n_hosts
@@ -438,7 +460,13 @@ class Runner:
         """Host shard -> globally-sharded device arrays (the reference's
         pinned-memory ``non_blocking`` H2D copies, :272-273).  For the LM
         task both halves are int32 token grids (inputs, next-token targets)."""
-        img = np.asarray(img, dtype=np.int32 if self.is_lm else np.float32)
+        if self.is_lm:
+            img_dtype = np.int32
+        elif self.device_normalize:
+            img_dtype = np.uint8  # normalized in-graph (4x smaller transfer)
+        else:
+            img_dtype = np.float32
+        img = np.asarray(img, dtype=img_dtype)
         label = np.asarray(label, dtype=np.int32)
         g_img = jax.make_array_from_process_local_data(self._img_sharding, img)
         g_label = jax.make_array_from_process_local_data(self._label_sharding, label)

@@ -67,6 +67,30 @@ def init_train_state(model, optimizer, rng, sample_input) -> TrainState:
     )
 
 
+def _input_normalizer(input_norm) -> Callable:
+    """Build the in-graph ``(x/255 - mean)/std`` affine for uint8 batches.
+
+    ``input_norm`` is ``(mean, std)`` per channel.  Uses the same
+    ``x*scale + bias`` form (f32) as the native host kernel
+    (native/__init__.py: scale=1/(255*std), bias=-mean/std) so device-side
+    normalization matches the host path to float rounding.  Identity when
+    ``input_norm`` is None (host-normalized float32 input — reference
+    parity).
+    """
+    if input_norm is None:
+        return lambda img: img
+    import numpy as np
+
+    mean, std = (np.asarray(x, np.float32) for x in input_norm)
+    scale = jnp.asarray(1.0 / (255.0 * std), jnp.float32)
+    bias = jnp.asarray(-mean / std, jnp.float32)
+
+    def normalize(img):
+        return img.astype(jnp.float32) * scale + bias
+
+    return normalize
+
+
 def build_train_step(
     model,
     optimizer,
@@ -74,6 +98,7 @@ def build_train_step(
     mesh: Mesh,
     sync_bn: bool,
     donate: bool = True,
+    input_norm=None,
 ):
     """Compile the full training iteration as one SPMD program.
 
@@ -87,9 +112,14 @@ def build_train_step(
       lr_fn: pure schedule ``lr(step)`` evaluated on-device (see
         :mod:`..schedulers`).
       sync_bn: whether BN stats are cross-replica (config ``training.sync_bn``).
+      input_norm: optional ``(mean, std)`` — the batch arrives as raw uint8
+        and is normalized in-graph (4x less host->device traffic; config
+        ``training.device_normalize``).
     """
+    normalize = _input_normalizer(input_norm)
 
     def body(params, batch_stats, opt_state, img, label):
+        img = normalize(img)
         def loss_fn(p):
             out, mutated = model.apply(
                 {"params": p, "batch_stats": batch_stats},
@@ -146,10 +176,12 @@ def build_train_step(
     return train_step
 
 
-def build_eval_step(model, mesh: Mesh):
+def build_eval_step(model, mesh: Mesh, input_norm=None):
     """Compile the distributed validation step (reference :309-321)."""
+    normalize = _input_normalizer(input_norm)
 
     def body(params, batch_stats, img, label):
+        img = normalize(img)
         out = model.apply(
             {"params": params, "batch_stats": batch_stats}, img, train=False
         )

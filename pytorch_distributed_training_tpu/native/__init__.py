@@ -82,6 +82,18 @@ def ensure_built() -> bool:
                 ctypes.POINTER(ctypes.c_int32),
             ]
             lib.pdt_decode_jpeg_batch.restype = None
+            lib.pdt_decode_jpeg_batch_u8.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_long,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.pdt_decode_jpeg_batch_u8.restype = None
             _lib = lib
             return True
         except Exception:
@@ -136,19 +148,22 @@ def decode_jpeg_batch(
     boxes: np.ndarray,
     flips: np.ndarray,
     out_size: int,
-    mean: np.ndarray,
-    std: np.ndarray,
+    mean: Optional[np.ndarray],
+    std: Optional[np.ndarray],
     out: Optional[np.ndarray] = None,
     dct_denom: int = 1,
     n_threads: int = 0,
 ):
-    """Decode a batch of JPEG files into normalized float32 NHWC images.
+    """Decode a batch of JPEG files into NHWC images.
 
     The native input-pipeline hot path (native/decode.cpp): per image —
     libjpeg decode, crop to ``boxes[i]`` (original-image coords), PIL-style
-    antialiased resize to ``out_size``, optional horizontal flip, fused
-    ``(x/255 - mean)/std`` normalization — parallelized over an internal C++
-    thread pool with the GIL released for the whole batch.
+    antialiased resize to ``out_size``, optional horizontal flip — then
+    either fused ``(x/255 - mean)/std`` normalization into float32, or, when
+    ``mean``/``std`` are ``None``, round-clamped raw uint8 (the
+    transfer-optimized mode: the normalization affine runs on the
+    accelerator and host->device traffic shrinks 4x).  Parallelized over an
+    internal C++ thread pool with the GIL released for the whole batch.
 
     Returns ``(out, status)``: ``status[i] != 0`` marks rows the kernel could
     not decode (non-JPEG, CMYK, corrupt); callers fall back to the PIL path
@@ -162,14 +177,12 @@ def decode_jpeg_batch(
     flips = np.ascontiguousarray(flips, dtype=np.uint8)
     if boxes.shape != (n, 4) or flips.shape != (n,):
         raise ValueError(f"boxes {boxes.shape} / flips {flips.shape} mismatch n={n}")
-    mean = np.asarray(mean, dtype=np.float32)
-    std = np.asarray(std, dtype=np.float32)
-    scale = (1.0 / (255.0 * std)).astype(np.float32)
-    bias = (-mean / std).astype(np.float32)
+    raw_u8 = mean is None and std is None
+    out_dtype = np.uint8 if raw_u8 else np.float32
     if out is None:
-        out = np.empty((n, out_size, out_size, 3), dtype=np.float32)
+        out = np.empty((n, out_size, out_size, 3), dtype=out_dtype)
     else:
-        if out.shape != (n, out_size, out_size, 3) or out.dtype != np.float32:
+        if out.shape != (n, out_size, out_size, 3) or out.dtype != out_dtype:
             raise ValueError(f"bad out buffer: {out.dtype} {out.shape}")
         if not out.flags["C_CONTIGUOUS"]:
             raise ValueError("out buffer must be C-contiguous")
@@ -177,6 +190,23 @@ def decode_jpeg_batch(
     c_paths = (ctypes.c_char_p * n)(
         *[os.fsencode(p) for p in paths]
     )
+    if raw_u8:
+        _lib.pdt_decode_jpeg_batch_u8(
+            c_paths,
+            boxes.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n,
+            out_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            int(dct_denom),
+            int(n_threads),
+            status.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out, status
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    scale = (1.0 / (255.0 * std)).astype(np.float32)
+    bias = (-mean / std).astype(np.float32)
     _lib.pdt_decode_jpeg_batch(
         c_paths,
         boxes.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),

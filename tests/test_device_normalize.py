@@ -1,0 +1,130 @@
+"""uint8 transfer + in-graph normalization (``training.device_normalize``).
+
+The host->device transfer is the e2e bottleneck once decode is native
+(measured: the f32 batch is 4x the bytes of the decoded pixels), so the
+loader can emit raw uint8 and the ``(x/255 - mean)/std`` affine runs inside
+the compiled step.  Oracles:
+  - the in-graph affine matches the host kernel's (same scale/bias form) to
+    float rounding;
+  - the native u8 decode output matches the PIL uint8 reference bytes;
+  - a Runner driven with ``device_normalize: True`` tracks the
+    host-normalized run's loss within uint8-quantization noise.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.data import DataLoader, SequentialSampler, get_dataset
+from pytorch_distributed_training_tpu.data.datasets import IMAGENET_MEAN, IMAGENET_STD
+from pytorch_distributed_training_tpu.engine import Runner
+from pytorch_distributed_training_tpu.engine.steps import _input_normalizer
+from pytorch_distributed_training_tpu.native import native_available, normalize_batch
+
+
+@pytest.fixture(scope="module")
+def jpeg_tree(tmp_path_factory):
+    from PIL import Image
+
+    root = tmp_path_factory.mktemp("dn_imagenet")
+    rng = np.random.default_rng(11)
+    for split, n in (("train", 24), ("val", 8)):
+        for cls in ("c0", "c1"):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(n):
+                base = rng.integers(0, 256, size=(12, 16, 3), dtype=np.uint8)
+                im = Image.fromarray(base).resize((100 + 9 * i, 80 + 6 * i))
+                im.save(d / f"img_{i}.jpg", "JPEG", quality=90)
+    return str(root)
+
+
+def test_in_graph_affine_matches_host_kernel():
+    rng = np.random.default_rng(0)
+    u8 = rng.integers(0, 256, (4, 16, 16, 3), dtype=np.uint8)
+    host = normalize_batch(u8, IMAGENET_MEAN, IMAGENET_STD)
+    device = _input_normalizer((IMAGENET_MEAN, IMAGENET_STD))(jnp.asarray(u8))
+    np.testing.assert_allclose(np.asarray(device), host, rtol=0, atol=1e-6)
+
+
+def test_identity_normalizer_passthrough():
+    x = jnp.ones((2, 4, 4, 3), jnp.float32)
+    assert _input_normalizer(None)(x) is x
+
+
+@pytest.mark.skipif(not native_available(), reason="native library unavailable")
+def test_native_u8_decode_matches_pil_reference(jpeg_tree):
+    """Native uint8 output == PIL uint8 path within one quantization level
+    (both paths quantize after the antialiased resample)."""
+    ds = get_dataset("imagenet", jpeg_tree, "val")
+    native = DataLoader(
+        ds, batch_size=8, sampler=SequentialSampler(len(ds)), num_workers=1,
+        worker_mode="native", output_dtype="uint8",
+    )
+    pil = DataLoader(
+        ds, batch_size=8, sampler=SequentialSampler(len(ds)), num_workers=1,
+        worker_mode="thread", output_dtype="uint8",
+    )
+    (n_img, n_lab), (p_img, p_lab) = next(iter(native)), next(iter(pil))
+    assert n_img.dtype == np.uint8 and p_img.dtype == np.uint8
+    np.testing.assert_array_equal(n_lab, p_lab)
+    diff = np.abs(n_img.astype(np.int16) - p_img.astype(np.int16))
+    assert float(np.mean(diff)) < 0.6
+    assert float(np.quantile(diff, 0.999)) <= 2, (diff.max(), np.mean(diff))
+
+
+def test_uint8_requires_normalizable_dataset(tmp_path):
+    ds = get_dataset("synthetic", str(tmp_path), "train", n_classes=4, image_size=8)
+    with pytest.raises(ValueError, match="uint8"):
+        DataLoader(
+            ds, batch_size=4, sampler=SequentialSampler(len(ds)),
+            output_dtype="uint8",
+        )
+
+
+def _cfg(root, device_normalize):
+    return {
+        "dataset": {"name": "imagenet", "root": root, "n_classes": 2, "image_size": 32},
+        "training": {
+            "optimizer": {
+                "name": "SGD", "lr": 0.01, "weight_decay": 1.0e-4, "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": 3,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,
+            "device_normalize": device_normalize,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+
+
+def _run(cfg):
+    scalars = []
+
+    class _TB:
+        def add_scalar(self, tag, value, step):
+            scalars.append((tag, float(value), step))
+
+    Runner(
+        num_nodes=1, rank=0, seed=1029, dist_url="tcp://127.0.0.1:9951",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=_TB,
+    )()
+    return [v for t, v, _ in scalars if t == "loss/train"]
+
+
+def test_runner_device_normalize_tracks_host_normalize(jpeg_tree):
+    host = _run(_cfg(jpeg_tree, False))
+    dev = _run(_cfg(jpeg_tree, True))
+    assert len(host) == len(dev) == 3
+    # identical samples/augmentation; numerics differ by the uint8
+    # quantization of the resample output (~0.5/255 per pixel), which an
+    # untrained BN net amplifies step over step — so this is a coherence
+    # check (same trajectory shape), not an equality oracle; exactness is
+    # pinned by the affine and u8-byte tests above
+    np.testing.assert_allclose(dev[0], host[0], rtol=0.03)
+    np.testing.assert_allclose(dev, host, rtol=0.15)
