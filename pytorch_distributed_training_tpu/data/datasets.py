@@ -126,13 +126,20 @@ class SyntheticTextDataset:
         self._successors = table_rng.integers(
             0, self.vocab_size, (self.vocab_size, 8), dtype=np.int32
         )
-        # plain nested lists for the chain walk: python-int indexing is ~10x
-        # faster than per-element numpy scalar indexing, and the walk is
-        # inherently sequential (each token depends on the previous)
-        self._succ_rows = self._successors.tolist()
+        # nested-python-list view of the table, built lazily on first use:
+        # python-int indexing is much faster than per-element numpy scalar
+        # indexing for the inherently sequential chain walk, but the list
+        # blow-up must not be paid by shape probes or pickled into process
+        # workers (it rebuilds per process on demand)
+        self._succ_rows = None
 
     def __len__(self) -> int:
         return self.n_samples
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_succ_rows"] = None  # rebuilt lazily in the worker
+        return state
 
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
         rng = np.random.default_rng(self._salt * 1_000_003 + idx)
@@ -141,6 +148,8 @@ class SyntheticTextDataset:
         choices = rng.integers(0, 8, self.seq_len).tolist()
         jumps = (rng.random(self.seq_len) < 0.1).tolist()
         randoms = rng.integers(0, self.vocab_size, self.seq_len).tolist()
+        if self._succ_rows is None:
+            self._succ_rows = self._successors.tolist()
         succ = self._succ_rows
         out = [cur]
         for t in range(self.seq_len):

@@ -179,18 +179,30 @@ class Runner:
             bool(train_cfg["sync_bn"]) and self.distributed and not self.is_lm
         )
         self.seq_par = int(train_cfg.get("sequence_parallelism", 1))
-        if self.seq_par > 1 and not self.is_lm:
+        self.tensor_par = int(train_cfg.get("tensor_parallelism", 1))
+        if (self.seq_par > 1 or self.tensor_par > 1) and not self.is_lm:
             raise ValueError(
-                "training.sequence_parallelism requires model.name: TransformerLM"
+                "training.sequence_parallelism / tensor_parallelism require "
+                "model.name: TransformerLM"
+            )
+        if self.seq_par > 1 and self.tensor_par > 1:
+            raise ValueError(
+                "sequence_parallelism and tensor_parallelism cannot be "
+                "combined yet — pick one (a 3-axis mesh is a follow-up)"
             )
         if self.is_lm:
-            if self.seq_par < 1 or jax.local_device_count() % self.seq_par != 0:
-                # the host-batch layout (and make_array_from_process_local_data)
-                # assumes each host holds whole sequence-shard groups
-                raise ValueError(
-                    f"training.sequence_parallelism ({self.seq_par}) must divide "
-                    f"the local device count ({jax.local_device_count()})"
-                )
+            for key, par in (
+                ("sequence_parallelism", self.seq_par),
+                ("tensor_parallelism", self.tensor_par),
+            ):
+                if par < 1 or jax.local_device_count() % par != 0:
+                    # the host-batch layout (and
+                    # make_array_from_process_local_data) assumes each host
+                    # holds whole shard groups
+                    raise ValueError(
+                        f"training.{key} ({par}) must divide the local "
+                        f"device count ({jax.local_device_count()})"
+                    )
             sample_inp, _ = train_dataset[0]
             self.seq_len = int(sample_inp.shape[0])
             if self.seq_len % self.seq_par != 0:
@@ -233,11 +245,14 @@ class Runner:
             raise ValueError(
                 f"training.batch_division must be 'local' or 'world', got {division!r}"
             )
-        # Batch rows shard over the DATA axis only; under sequence
-        # parallelism each group of seq_par devices holds one batch shard,
-        # so the division unit is a data shard, not a device.
-        units_local = local_devices // self.seq_par if self.is_lm else local_devices
-        units_world = self.world_size // self.seq_par if self.is_lm else self.world_size
+        # Batch rows shard over the DATA axis only; under sequence/tensor
+        # parallelism each group of seq_par (or tensor_par) devices holds one
+        # batch shard, so the division unit is a data shard, not a device.
+        # (seq_par and tensor_par are mutually exclusive, so the product is
+        # whichever is active.)
+        non_data = self.seq_par * self.tensor_par if self.is_lm else 1
+        units_local = local_devices // non_data
+        units_world = self.world_size // non_data
         if self.distributed:
             divisor = units_world if division == "world" else units_local
             per_device_batch = batch_size // max(divisor, 1)
@@ -339,7 +354,36 @@ class Runner:
         )
 
         # --- mesh + compiled steps + replicated state -----------------------
-        if self.is_lm:
+        if self.is_lm and self.tensor_par > 1:
+            # (data, model) mesh, GSPMD Megatron sharding (parallel/tensor):
+            # params live sharded over the model axis; XLA inserts the
+            # row-parallel all-reduces and the gradient all-reduce itself
+            from ..parallel.tensor import tp_state_shardings
+            from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step
+
+            if self.model.num_heads % self.tensor_par != 0:
+                # the Megatron column split lands on whole-head boundaries
+                raise ValueError(
+                    f"model.num_heads ({self.model.num_heads}) must be "
+                    f"divisible by training.tensor_parallelism ({self.tensor_par})"
+                )
+            self.mesh = make_mesh(model_parallelism=self.tensor_par)
+            sample = jnp.zeros((1, self.seq_len), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            state = TrainState(
+                params=params,
+                batch_stats={},
+                opt_state=self.optimizer.init(params),
+            )
+            self.state = jax.device_put(state, tp_state_shardings(state, self.mesh))
+            self.train_step = build_tp_lm_train_step(
+                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh
+            )(self.state)
+            self.eval_step = build_tp_lm_eval_step(self.model, self.mesh)(self.state)
+            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            self._img_sharding = tok_sharding
+            self._label_sharding = tok_sharding
+        elif self.is_lm:
             # (data, sequence) mesh; with sequence_parallelism == 1 the
             # sequence axis is trivial and this is plain DP over tokens
             self.mesh = make_sp_mesh(self.seq_par)

@@ -25,7 +25,7 @@ from ..parallel.mesh import DATA_AXIS
 from ..parallel.tensor import tp_state_shardings
 from .steps import TrainState
 
-__all__ = ["build_tp_lm_train_step"]
+__all__ = ["build_tp_lm_train_step", "build_tp_lm_eval_step"]
 
 
 def build_tp_lm_train_step(
@@ -70,6 +70,38 @@ def build_tp_lm_train_step(
             in_shardings=(state_sh, tok_sh, tok_sh),
             out_shardings=(state_sh, rep),
             donate_argnums=(0,) if donate else (),
+        )
+
+    return compile_for
+
+
+def build_tp_lm_eval_step(model, mesh: Mesh):
+    """Compile the TP LM validation step (GSPMD-partitioned).
+
+    Same contract as the other eval steps — replicated ``(loss, acc1,
+    acc5)``: mean CE per token + next-token top-1/top-5 — so
+    ``Runner.validate`` drives it unchanged.  Like the train step, returns a
+    ``compile_for(state)`` closure that pins the TP state shardings.
+    """
+    from ..metrics import accuracy
+
+    def step(state: TrainState, tokens, labels):
+        logits = model.apply({"params": state.params}, tokens)
+        vocab = logits.shape[-1]
+        flat_logits = logits.reshape(-1, vocab)
+        flat_labels = labels.reshape(-1)
+        loss = cross_entropy_loss(flat_logits, flat_labels)
+        acc1, acc5 = accuracy(flat_logits, flat_labels, topk=(1, 5))
+        return loss, acc1, acc5
+
+    def compile_for(state: TrainState):
+        state_sh = tp_state_shardings(state, mesh)
+        tok_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        rep = NamedSharding(mesh, P())
+        return jax.jit(
+            step,
+            in_shardings=(state_sh, tok_sh, tok_sh),
+            out_shardings=(rep, rep, rep),
         )
 
     return compile_for

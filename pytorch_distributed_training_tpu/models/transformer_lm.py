@@ -66,6 +66,7 @@ class TransformerLM(nn.Module):
     mlp_ratio: float = 4.0
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
+    remat: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -99,8 +100,14 @@ class TransformerLM(nn.Module):
         else:
             pe = pos[:s]
         x = x + pe[None].astype(self.dtype)
+        # remat (rematerialization): recompute block activations in the
+        # backward pass instead of storing them — trades ~1/3 extra FLOPs
+        # for O(depth) less activation HBM, the standard long-context lever
+        # (config: model.remat: true).  Parameter shapes/values are
+        # unchanged, so remat toggling is checkpoint-compatible.
+        block_cls = nn.remat(DecoderBlock) if self.remat else DecoderBlock
         for i in range(self.depth):
-            x = DecoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis if not self.is_initializing() else None,

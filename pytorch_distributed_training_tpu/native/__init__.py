@@ -177,7 +177,12 @@ def decode_jpeg_batch(
     flips = np.ascontiguousarray(flips, dtype=np.uint8)
     if boxes.shape != (n, 4) or flips.shape != (n,):
         raise ValueError(f"boxes {boxes.shape} / flips {flips.shape} mismatch n={n}")
-    raw_u8 = mean is None and std is None
+    if (mean is None) != (std is None):
+        raise ValueError(
+            "mean and std must both be None (uint8 mode) or both be set "
+            f"(normalized f32 mode); got mean={mean!r} std={std!r}"
+        )
+    raw_u8 = mean is None
     out_dtype = np.uint8 if raw_u8 else np.float32
     if out is None:
         out = np.empty((n, out_size, out_size, 3), dtype=out_dtype)

@@ -31,12 +31,48 @@ from typing import Any, Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SGD", "LARS", "get_optimizer", "OPTIMIZERS", "SGDState"]
+__all__ = [
+    "SGD",
+    "LARS",
+    "AdamW",
+    "get_optimizer",
+    "OPTIMIZERS",
+    "SGDState",
+    "AdamWState",
+]
 
 
 class SGDState(NamedTuple):
     momentum: Any  # pytree like params (zeros when momentum == 0)
     step: jnp.ndarray  # scalar int32, number of updates applied so far
+
+
+class AdamWState(NamedTuple):
+    mu: Any  # first-moment pytree like params
+    nu: Any  # second-moment pytree like params
+    step: jnp.ndarray  # scalar int32, number of updates applied so far
+
+
+class _Out(NamedTuple):
+    """Per-leaf update result bundle.
+
+    A dedicated type (not a plain tuple) so the unzip's ``is_leaf`` can't
+    mistake tuple/NamedTuple *container* nodes of a user's params pytree for
+    update results — with a bare ``isinstance(t, tuple)`` predicate, params
+    stored in a tuple would be silently unzipped into a corrupted tree.
+    """
+
+    param: Any
+    aux1: Any
+    aux2: Any = None
+
+
+def _unzip(tree_of_out, n: int):
+    """Split a pytree of ``_Out`` bundles into n parallel pytrees."""
+    is_out = lambda t: isinstance(t, _Out)  # noqa: E731
+    return tuple(
+        jax.tree.map(lambda t: t[i], tree_of_out, is_leaf=is_out) for i in range(n)
+    )
 
 
 class SGD:
@@ -79,11 +115,10 @@ class SGD:
             else:
                 new_buf = buf
                 step_dir = d
-            return p - lr * step_dir, new_buf
+            return _Out(p - lr * step_dir, new_buf)
 
         flat = jax.tree.map(one, grads, params, state.momentum)
-        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        new_bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_params, new_bufs = _unzip(flat, 2)
         return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
 
 
@@ -149,17 +184,70 @@ class LARS:
                 )
                 d = trust * (g + wd * p)
             new_buf = mu * buf + d
-            return p - lr * new_buf, new_buf
+            return _Out(p - lr * new_buf, new_buf)
 
         flat = jax.tree.map(one, grads, params, state.momentum)
-        new_params = jax.tree.map(lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple))
-        new_bufs = jax.tree.map(lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple))
+        new_params, new_bufs = _unzip(flat, 2)
         return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
+
+
+class AdamW:
+    """``torch.optim.AdamW``-semantics AdamW (decoupled weight decay).
+
+    Exact torch update order (torch/optim/adamw.py single-tensor path):
+      1. ``p <- p * (1 - lr * wd)``          (decoupled decay, BEFORE the step)
+      2. ``mu <- b1*mu + (1-b1)*g``; ``nu <- b2*nu + (1-b2)*g^2``
+      3. bias correction ``bc1 = 1-b1^t``, ``bc2 = 1-b2^t`` (t counts from 1)
+      4. ``p <- p - (lr/bc1) * mu / (sqrt(nu)/sqrt(bc2) + eps)``
+    Note torch divides by ``sqrt(nu/bc2) + eps`` with eps OUTSIDE the sqrt
+    and applied to the bias-corrected denom — replicated exactly (the optax
+    ``adamw`` eps placement differs).  The default LM optimizer beyond the
+    reference's SGD-only surface (transformers want Adam-family updates).
+    """
+
+    def __init__(
+        self,
+        lr: float,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        weight_decay: float = 1e-2,
+    ):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+
+    def init(self, params) -> AdamWState:
+        return AdamWState(
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def update(self, grads, state: AdamWState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        t = (state.step + 1).astype(jnp.float32)
+        bc1 = 1.0 - b1**t
+        bc2 = 1.0 - b2**t
+
+        def one(g, p, mu, nu):
+            p = p * (1.0 - lr * wd)
+            new_mu = b1 * mu + (1.0 - b1) * g
+            new_nu = b2 * nu + (1.0 - b2) * jnp.square(g)
+            denom = jnp.sqrt(new_nu) / jnp.sqrt(bc2) + eps
+            return _Out(p - (lr / bc1) * new_mu / denom, new_mu, new_nu)
+
+        flat = jax.tree.map(one, grads, params, state.mu, state.nu)
+        new_params, new_mu, new_nu = _unzip(flat, 3)
+        return new_params, AdamWState(mu=new_mu, nu=new_nu, step=state.step + 1)
 
 
 OPTIMIZERS = {
     "SGD": SGD,
     "LARS": LARS,
+    "AdamW": AdamW,
 }
 
 

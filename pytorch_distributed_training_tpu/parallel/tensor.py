@@ -63,15 +63,26 @@ def lm_tp_shardings(params, mesh: Mesh):
 
 
 def tp_state_shardings(state, mesh: Mesh):
-    """Shardings for a ``TrainState``: momentum mirrors its parameter."""
+    """Shardings for a ``TrainState``: per-parameter optimizer moments
+    (SGD momentum, AdamW mu/nu, ...) mirror their parameter's sharding.
+
+    Generic over the optimizer: any opt_state NamedTuple field whose pytree
+    structure matches ``params`` is treated as a parameter mirror; scalar
+    fields (step counters) stay replicated.
+    """
     from ..engine.steps import TrainState  # avoid import cycle at module load
 
     assert isinstance(state, TrainState)
     param_sh = lm_tp_shardings(state.params, mesh)
     rep = NamedSharding(mesh, P())
-    opt_sh = type(state.opt_state)(
-        momentum=lm_tp_shardings(state.opt_state.momentum, mesh),
-        step=rep,
-    )
+    params_struct = jax.tree.structure(state.params)
+    fields = {}
+    for name in state.opt_state._fields:
+        field = getattr(state.opt_state, name)
+        if jax.tree.structure(field) == params_struct:
+            fields[name] = param_sh
+        else:
+            fields[name] = jax.tree.map(lambda _: rep, field)
+    opt_sh = type(state.opt_state)(**fields)
     bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
     return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 import torch
 
-from pytorch_distributed_training_tpu.optimizers import LARS, SGD, get_optimizer
+from pytorch_distributed_training_tpu.optimizers import LARS, SGD, AdamW, get_optimizer
 
 
 def _run_parity(momentum, weight_decay, nesterov, dampening=0.0, steps=6):
@@ -85,8 +85,49 @@ def test_sgd_jit_compatible():
 def test_factory():
     assert get_optimizer({"name": "SGD"}) is SGD
     assert get_optimizer({"name": "LARS"}) is LARS
+    assert get_optimizer({"name": "AdamW"}) is AdamW
     with pytest.raises(KeyError):
         get_optimizer({"name": "Adam"})
+
+
+def _run_adamw_parity(weight_decay, betas=(0.9, 0.999), eps=1e-8, steps=6):
+    rng = np.random.default_rng(7)
+    shapes = [(4, 3), (7,), (2, 2, 3)]
+    params_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+    grads_np = [
+        [rng.normal(size=s).astype(np.float32) for s in shapes] for _ in range(steps)
+    ]
+
+    t_params = [torch.nn.Parameter(torch.tensor(p)) for p in params_np]
+    t_opt = torch.optim.AdamW(
+        t_params, lr=1e-3, betas=betas, eps=eps, weight_decay=weight_decay
+    )
+    for step_grads in grads_np:
+        for p, g in zip(t_params, step_grads):
+            p.grad = torch.tensor(g)
+        t_opt.step()
+
+    opt = AdamW(lr=1e-3, betas=betas, eps=eps, weight_decay=weight_decay)
+    params = [jnp.asarray(p) for p in params_np]
+    state = opt.init(params)
+    for step_grads in grads_np:
+        params, state = opt.update([jnp.asarray(g) for g in step_grads], state, params)
+
+    for ours, theirs in zip(params, t_params):
+        np.testing.assert_allclose(
+            np.asarray(ours), theirs.detach().numpy(), rtol=1e-5, atol=1e-7
+        )
+
+
+def test_adamw_parity_defaults():
+    """torch.optim.AdamW defaults: decoupled decay applied BEFORE the Adam
+    step, eps added to the bias-corrected denom OUTSIDE the sqrt."""
+    _run_adamw_parity(weight_decay=1e-2)
+
+
+def test_adamw_parity_no_decay_and_heavy_decay():
+    _run_adamw_parity(weight_decay=0.0)
+    _run_adamw_parity(weight_decay=0.3, betas=(0.8, 0.95), eps=1e-6)
 
 
 def test_lars_smoke():
@@ -153,3 +194,16 @@ def test_lars_exclusion_lm_tree():
     assert ln_scales, f"LayerNorm scales must be excluded, got {sorted(excluded)}"
     # embeddings and matmul kernels are rank>=2: never excluded
     assert not any("embedding" in p or p.endswith("kernel") for p in excluded)
+
+
+def test_tuple_structured_params_not_corrupted():
+    """The update's internal unzip uses a dedicated result type, so params
+    stored in a tuple pytree must round-trip with their structure intact
+    (a bare isinstance(t, tuple) is_leaf would swallow the container)."""
+    params = (jnp.ones((2, 2)), jnp.zeros((3,)))
+    grads = (jnp.full((2, 2), 0.1), jnp.full((3,), 0.2))
+    for opt in (SGD(lr=0.1, momentum=0.9), LARS(lr=0.1), AdamW(lr=1e-3)):
+        state = opt.init(params)
+        new_params, _ = opt.update(grads, state, params)
+        assert isinstance(new_params, tuple) and len(new_params) == 2
+        assert new_params[0].shape == (2, 2) and new_params[1].shape == (3,)

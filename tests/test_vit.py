@@ -64,3 +64,47 @@ def test_dp_train_step_without_batch_stats():
         0.0,
     )
     assert delta > 0
+
+
+def test_runner_vit_adamw_end_to_end(tmp_path):
+    """ViT driven from the config surface (synthetic data, AdamW) through
+    the full Runner — the image task is not ResNet-specific."""
+    from pytorch_distributed_training_tpu.engine import Runner
+
+    scalars = []
+
+    class _TB:
+        def add_scalar(self, tag, value, step):
+            scalars.append((tag, float(value), step))
+
+    cfg = {
+        "dataset": {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {"name": "AdamW", "lr": 1.0e-3, "weight_decay": 1.0e-2},
+            "lr_schedule": {"name": "cosine", "total_iters": 4},
+            "train_iters": 4,
+            "print_interval": 2,
+            "val_interval": 3,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,  # accepted + ignored: ViT has no batch stats
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ViT-Ti16"},
+    }
+    runner = Runner(
+        num_nodes=1, rank=0, seed=1029, dist_url="tcp://127.0.0.1:9961",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=_TB,
+    )
+    runner()
+    assert runner.iter == 4
+    losses = [v for t, v, _ in scalars if t == "loss/train"]
+    assert losses and np.isfinite(losses).all()
+    assert any(t == "eval/Acc@1" for t, _, _ in scalars)
