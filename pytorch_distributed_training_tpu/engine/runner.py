@@ -253,6 +253,16 @@ class Runner:
         non_data = self.seq_par * self.tensor_par if self.is_lm else 1
         units_local = local_devices // non_data
         units_world = self.world_size // non_data
+        # Additive key ``training.grad_accumulation``: per-step micro-batch
+        # count (lax.scan inside the compiled step — activation memory / N,
+        # identical update math; engine/steps.py).
+        self.grad_accum = int(train_cfg.get("grad_accumulation", 1))
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accumulation must be >= 1, got {self.grad_accum}")
+        if self.grad_accum > 1 and self.tensor_par > 1:
+            raise ValueError(
+                "grad_accumulation is not supported with tensor_parallelism yet"
+            )
         if self.distributed:
             divisor = units_world if division == "world" else units_local
             per_device_batch = batch_size // max(divisor, 1)
@@ -270,6 +280,13 @@ class Runner:
             host_batch = per_device_batch * units_local
         else:
             host_batch = batch_size
+            per_device_batch = batch_size
+        if per_device_batch % self.grad_accum != 0:
+            # fail fast like every other config error, not at jit trace time
+            raise ValueError(
+                f"per-shard batch ({per_device_batch}) not divisible by "
+                f"training.grad_accumulation ({self.grad_accum})"
+            )
         # One controller per host: cfg num_workers = decode threads per host
         # (the reference divides workers among its per-GPU processes, :195 —
         # same total per host).
@@ -396,7 +413,8 @@ class Runner:
             )
             self.state = jax.device_put(state, replicated_sharding(self.mesh))
             self.train_step = build_lm_train_step(
-                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh
+                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
+                grad_accum=self.grad_accum,
             )
             self.eval_step = build_lm_eval_step(self.model, self.mesh)
             # tokens/targets are [batch, seq], sharded over BOTH mesh axes
@@ -418,6 +436,7 @@ class Runner:
                 self.mesh,
                 sync_bn=sync_bn,
                 input_norm=self._input_norm,
+                grad_accum=self.grad_accum,
             )
             self.eval_step = build_eval_step(
                 self.model, self.mesh, input_norm=self._input_norm

@@ -62,11 +62,18 @@ def build_lm_train_step(
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQUENCE_AXIS,
     donate: bool = True,
+    grad_accum: int = 1,
 ):
     """Compile one DP x SP training iteration for a :class:`TransformerLM`.
 
     ``model.seq_axis`` must equal ``seq_axis`` (the module runs its ring
     attention over that mesh axis); ``mesh`` must carry both axes.
+
+    ``grad_accum``: process the local batch as N sequential micro-batches
+    under ``lax.scan`` (activation memory / N).  Each micro loss is already
+    a partial sum normalized by the GLOBAL token count, so accumulating
+    grad/loss *sums* over micros reproduces the full-batch objective
+    exactly.
     """
     axes = (data_axis, seq_axis)
     n_data = mesh.shape[data_axis]
@@ -76,8 +83,8 @@ def build_lm_train_step(
         b_local, s_local = tokens.shape
         global_tokens = b_local * s_local * n_data * n_seq
 
-        def loss_fn(p):
-            logits = model.apply({"params": p}, tokens)
+        def loss_fn(p, tok, lab):
+            logits = model.apply({"params": p}, tok)
             # objective = GLOBAL mean CE per token: psum of the local partial
             # sums (each already /global_tokens).  Differentiating this
             # replicated scalar yields the exact global gradient directly —
@@ -85,10 +92,33 @@ def build_lm_train_step(
             # across both mesh axes (an explicit post-grad psum would
             # double-count; regression-tested in tests/test_transformer_lm.py)
             return jax.lax.psum(
-                lm_loss_local(logits, labels, global_tokens), axes
+                lm_loss_local(logits, lab, global_tokens), axes
             )
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if grad_accum > 1:
+            if b_local % grad_accum != 0:
+                raise ValueError(
+                    f"per-shard batch {b_local} not divisible by "
+                    f"grad_accumulation {grad_accum}"
+                )
+            micro = b_local // grad_accum
+            tok = tokens.reshape(grad_accum, micro, s_local)
+            lab = labels.reshape(grad_accum, micro, s_local)
+            zero = jax.tree.map(jnp.zeros_like, params)
+
+            def scan_step(carry, xy):
+                acc, loss_acc = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, *xy)
+                return (
+                    jax.tree.map(jnp.add, acc, grads),
+                    loss_acc + loss,
+                ), None
+
+            (grads, loss), _ = jax.lax.scan(
+                scan_step, (zero, jnp.float32(0.0)), (tok, lab)
+            )
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         lr = lr_fn(opt_state.step)
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
         return new_params, new_opt, loss

@@ -99,6 +99,7 @@ def build_train_step(
     sync_bn: bool,
     donate: bool = True,
     input_norm=None,
+    grad_accum: int = 1,
 ):
     """Compile the full training iteration as one SPMD program.
 
@@ -115,11 +116,22 @@ def build_train_step(
       input_norm: optional ``(mean, std)`` — the batch arrives as raw uint8
         and is normalized in-graph (4x less host->device traffic; config
         ``training.device_normalize``).
+      grad_accum: micro-batch count (config ``training.grad_accumulation``).
+        The per-device batch is processed as ``grad_accum`` sequential
+        micro-batches under ``lax.scan`` — activation memory shrinks by the
+        factor while the update stays the mean over the full batch (equal
+        micro sizes => mean of micro means == full mean).  BN running stats
+        update once per micro-batch with per-micro statistics, matching
+        torch's behavior when accumulating under DDP.
     """
     normalize = _input_normalizer(input_norm)
 
-    def body(params, batch_stats, opt_state, img, label):
+    def micro_loss(params, batch_stats, img, label):
+        # normalize PER MICRO-BATCH: converting uint8 -> f32 up front would
+        # pin a 4x-size buffer across the whole accumulation scan, defeating
+        # the memory savings grad_accum exists for
         img = normalize(img)
+
         def loss_fn(p):
             out, mutated = model.apply(
                 {"params": p, "batch_stats": batch_stats},
@@ -142,7 +154,35 @@ def build_train_step(
             # models without batch statistics (e.g. ViT) mutate nothing
             return loss, mutated.get("batch_stats", {})
 
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+    def body(params, batch_stats, opt_state, img, label):
+        if grad_accum > 1:
+            b = img.shape[0]
+            if b % grad_accum != 0:
+                raise ValueError(
+                    f"per-device batch {b} not divisible by "
+                    f"grad_accumulation {grad_accum}"
+                )
+            micro = b // grad_accum
+            img = img.reshape(grad_accum, micro, *img.shape[1:])
+            label = label.reshape(grad_accum, micro)
+            zero_grads = jax.tree.map(jnp.zeros_like, params)
+
+            def scan_step(carry, xy):
+                bs, acc, loss_acc = carry
+                (loss, new_bs), grads = micro_loss(params, bs, *xy)
+                acc = jax.tree.map(
+                    lambda a, g: a + g / grad_accum, acc, grads
+                )
+                return (new_bs, acc, loss_acc + loss / grad_accum), None
+
+            (new_bs, grads, loss), _ = jax.lax.scan(
+                scan_step, (batch_stats, zero_grads, jnp.float32(0.0)),
+                (img, label),
+            )
+        else:
+            (loss, new_bs), grads = micro_loss(params, batch_stats, img, label)
         if not sync_bn:
             # Local BN stats diverge per replica; average them so the state
             # stays replicated (the reference's DDP broadcast_buffers keeps

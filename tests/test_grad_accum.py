@@ -1,0 +1,140 @@
+"""Gradient accumulation (``training.grad_accumulation``).
+
+The per-device batch is processed as N sequential micro-batches under
+``lax.scan`` inside the compiled step — an activation-memory knob whose
+update math must equal the plain full-batch step.  Oracles:
+  - for a batch-stat-free model (ViT) the accumulated step equals the plain
+    step to float tolerance (mean of equal-size micro means == full mean);
+  - for the SP LM step likewise (micro losses are partial sums normalized
+    by the global token count, so sums reproduce the objective exactly);
+  - for ResNet (BN), stats update per micro-batch (torch-DDP-accumulation
+    semantics) — trained loss still decreases and states stay finite.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.engine import (
+    TrainState,
+    build_lm_train_step,
+    build_train_step,
+    init_train_state,
+)
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    make_sp_mesh,
+    replicated_sharding,
+)
+from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+
+def test_vit_accum_matches_plain_step():
+    mesh = make_mesh()
+    model = get_model("ViT-Ti16", num_classes=8)
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.01, [1000], 0.1)
+    state0 = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    rng = np.random.default_rng(0)
+    img = jax.device_put(
+        rng.standard_normal((32, 32, 32, 3)).astype(np.float32),
+        batch_sharding(mesh, 4),
+    )
+    label = jax.device_put(
+        rng.integers(0, 8, (32,)).astype(np.int32), batch_sharding(mesh, 1)
+    )
+
+    plain = build_train_step(model, opt, lr_fn, mesh, sync_bn=False, donate=False)
+    accum = build_train_step(
+        model, opt, lr_fn, mesh, sync_bn=False, donate=False, grad_accum=4
+    )
+    s_p, l_p = plain(jax.device_put(state0, replicated_sharding(mesh)), img, label)
+    s_a, l_a = accum(jax.device_put(state0, replicated_sharding(mesh)), img, label)
+    assert np.isclose(float(l_p), float(l_a), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_a.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_lm_sp_accum_matches_plain_step():
+    mesh = make_sp_mesh(sequence_parallelism=4)
+    lm = TransformerLM(
+        vocab_size=32, max_len=16, embed_dim=16, depth=2, num_heads=2,
+        seq_axis="sequence",
+    )
+    opt = SGD(lr=0.05, momentum=0.9)
+    lr_fn = multi_step_lr(0.05, [1000], 0.1)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 32, (8, 17)).astype(np.int32)
+    params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :16]))["params"]
+
+    def run(grad_accum):
+        state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step = build_lm_train_step(
+            lm, opt, lr_fn, mesh, donate=False, grad_accum=grad_accum
+        )
+        return step(
+            state, jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:])
+        )
+
+    s_p, l_p = run(1)
+    s_a, l_a = run(4)
+    assert np.isclose(float(l_p), float(l_a), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(s_p.params), jax.tree.leaves(s_a.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_resnet_accum_trains_and_updates_stats():
+    mesh = make_mesh()
+    model = get_model("ResNet18", num_classes=8, axis_name="data")
+    opt = SGD(lr=0.01, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.01, [1000], 0.1)
+    state = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = build_train_step(model, opt, lr_fn, mesh, sync_bn=True, grad_accum=2)
+    rng = np.random.default_rng(2)
+    img = rng.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    img += 0.5 * (np.arange(16) % 8)[:, None, None, None] / 8
+    g_img = jax.device_put(img, batch_sharding(mesh, 4))
+    g_lab = jax.device_put(
+        (np.arange(16) % 8).astype(np.int32), batch_sharding(mesh, 1)
+    )
+    before = jax.tree.map(np.asarray, state.batch_stats)
+    losses = []
+    for _ in range(8):
+        state, loss = step(state, g_img, g_lab)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert min(losses[-3:]) < losses[0]
+    after = jax.tree.map(np.asarray, state.batch_stats)
+    changed = jax.tree.leaves(
+        jax.tree.map(lambda a, b: not np.allclose(a, b), before, after)
+    )
+    assert any(changed)
+
+
+def test_indivisible_micro_batch_raises():
+    mesh = make_mesh()
+    model = get_model("ViT-Ti16", num_classes=8)
+    opt = SGD(lr=0.01)
+    with pytest.raises(ValueError, match="divisible"):
+        step = build_train_step(
+            model, opt, multi_step_lr(0.01, [1], 0.1), mesh,
+            sync_bn=False, grad_accum=3,
+        )
+        state = init_train_state(
+            model, opt, jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3))
+        )
+        step(
+            jax.device_put(state, replicated_sharding(mesh)),
+            jax.device_put(np.zeros((16, 32, 32, 3), np.float32), batch_sharding(mesh, 4)),
+            jax.device_put(np.zeros((16,), np.int32), batch_sharding(mesh, 1)),
+        )
