@@ -263,6 +263,19 @@ class Runner:
             raise ValueError(
                 "grad_accumulation is not supported with tensor_parallelism yet"
             )
+        # Additive keys: torch-convention label smoothing + params EMA
+        # (evaluation runs with the EMA weights when enabled).
+        self.label_smoothing = float(train_cfg.get("label_smoothing", 0.0))
+        if not (0.0 <= self.label_smoothing < 1.0):
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), got {self.label_smoothing}"
+            )
+        ema_cfg = train_cfg.get("ema")
+        self.ema_decay = float(ema_cfg["decay"]) if ema_cfg else None
+        if self.ema_decay is not None and not (0.0 < self.ema_decay < 1.0):
+            raise ValueError(f"ema.decay must be in (0, 1), got {self.ema_decay}")
+        if self.ema_decay is not None and self.is_lm:
+            raise ValueError("training.ema is only wired for the image task")
         if self.distributed:
             divisor = units_world if division == "world" else units_local
             per_device_batch = batch_size // max(divisor, 1)
@@ -394,7 +407,8 @@ class Runner:
             )
             self.state = jax.device_put(state, tp_state_shardings(state, self.mesh))
             self.train_step = build_tp_lm_train_step(
-                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh
+                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
+                label_smoothing=self.label_smoothing,
             )(self.state)
             self.eval_step = build_tp_lm_eval_step(self.model, self.mesh)(self.state)
             tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
@@ -415,6 +429,7 @@ class Runner:
             self.train_step = build_lm_train_step(
                 self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
                 grad_accum=self.grad_accum,
+                label_smoothing=self.label_smoothing,
             )
             self.eval_step = build_lm_eval_step(self.model, self.mesh)
             # tokens/targets are [batch, seq], sharded over BOTH mesh axes
@@ -428,6 +443,11 @@ class Runner:
             state = init_train_state(
                 self.model, self.optimizer, jax.random.PRNGKey(seed), sample
             )
+            if self.ema_decay is not None:
+                # EMA starts at the initial weights (standard convention).
+                # jnp.copy: ema must NOT alias the params buffers — the
+                # donated train step would otherwise donate them twice
+                state = state.replace(ema=jax.tree.map(jnp.copy, state.params))
             self.state = jax.device_put(state, replicated_sharding(self.mesh))
             self.train_step = build_train_step(
                 self.model,
@@ -437,6 +457,8 @@ class Runner:
                 sync_bn=sync_bn,
                 input_norm=self._input_norm,
                 grad_accum=self.grad_accum,
+                label_smoothing=self.label_smoothing,
+                ema_decay=self.ema_decay,
             )
             self.eval_step = build_eval_step(
                 self.model, self.mesh, input_norm=self._input_norm
@@ -587,9 +609,15 @@ class Runner:
         loss_meter = AverageMeter()
         top_1 = AverageMeter()
         top_5 = AverageMeter()
+        # with EMA enabled, validation runs on the averaged weights
+        eval_state = (
+            self.state.replace(params=self.state.ema)
+            if getattr(self, "ema_decay", None) is not None
+            else self.state
+        )
         for img, label in tqdm.tqdm(self.val_loader, disable=self.current_rank != 0):
             g_img, g_label = self._put_batch(img, label)
-            loss, acc1, acc5 = self.eval_step(self.state, g_img, g_label)
+            loss, acc1, acc5 = self.eval_step(eval_state, g_img, g_label)
             # already replica-averaged in-graph (reference :315-321)
             loss_meter.update(float(loss))
             top_1.update(float(acc1))

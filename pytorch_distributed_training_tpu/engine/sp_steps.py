@@ -39,7 +39,7 @@ from .steps import TrainState
 __all__ = ["build_lm_train_step", "build_lm_eval_step", "lm_loss_local"]
 
 
-def lm_loss_local(logits, labels, global_tokens: int):
+def lm_loss_local(logits, labels, global_tokens: int, label_smoothing: float = 0.0):
     """Local partial loss: sum of per-token CE / global token count (fp32).
 
     Routes through :func:`..ops.cross_entropy_loss` (token-flattened), so the
@@ -49,7 +49,7 @@ def lm_loss_local(logits, labels, global_tokens: int):
     """
     vocab = logits.shape[-1]
     local_mean = cross_entropy_loss(
-        logits.reshape(-1, vocab), labels.reshape(-1)
+        logits.reshape(-1, vocab), labels.reshape(-1), label_smoothing
     )
     return local_mean * (labels.size / global_tokens)
 
@@ -63,6 +63,7 @@ def build_lm_train_step(
     seq_axis: str = SEQUENCE_AXIS,
     donate: bool = True,
     grad_accum: int = 1,
+    label_smoothing: float = 0.0,
 ):
     """Compile one DP x SP training iteration for a :class:`TransformerLM`.
 
@@ -92,7 +93,7 @@ def build_lm_train_step(
             # across both mesh axes (an explicit post-grad psum would
             # double-count; regression-tested in tests/test_transformer_lm.py)
             return jax.lax.psum(
-                lm_loss_local(logits, lab, global_tokens), axes
+                lm_loss_local(logits, lab, global_tokens, label_smoothing), axes
             )
 
         if grad_accum > 1:
@@ -138,7 +139,10 @@ def build_lm_train_step(
             state.params, state.opt_state, tokens, labels
         )
         return (
-            TrainState(params=new_params, batch_stats=state.batch_stats, opt_state=new_opt),
+            TrainState(
+                params=new_params, batch_stats=state.batch_stats,
+                opt_state=new_opt, ema=state.ema,
+            ),
             loss,
         )
 

@@ -42,11 +42,16 @@ class TrainState(struct.PyTreeNode):
     keeps them in sync via grad allreduce + buffer broadcast) and
     ``optimizer.state`` (momentum buffers, train_distributed.py:207).  The
     iteration counter lives in ``opt_state.step``.
+
+    ``ema``: exponential moving average of params (config ``training.ema``;
+    empty dict when disabled, so the pytree stays checkpoint- and
+    shard_map-friendly without structural branching).
     """
 
     params: Any
     batch_stats: Any
     opt_state: Any
+    ema: Any = struct.field(default_factory=dict)
 
     @property
     def step(self):
@@ -100,6 +105,8 @@ def build_train_step(
     donate: bool = True,
     input_norm=None,
     grad_accum: int = 1,
+    label_smoothing: float = 0.0,
+    ema_decay: Optional[float] = None,
 ):
     """Compile the full training iteration as one SPMD program.
 
@@ -123,6 +130,15 @@ def build_train_step(
         micro sizes => mean of micro means == full mean).  BN running stats
         update once per micro-batch with per-micro statistics, matching
         torch's behavior when accumulating under DDP.
+      label_smoothing: torch-convention smoothing factor (config
+        ``training.label_smoothing``; 0 = reference parity).  Deliberately
+        applied to the TRAINING objective only — the eval step reports
+        unsmoothed CE so validation losses stay comparable across smoothing
+        settings (the perplexity convention).
+      ema_decay: when set, maintain ``state.ema`` as the exponential moving
+        average of the updated params, ``ema <- d*ema + (1-d)*params``
+        (config ``training.ema.decay``; the Runner evaluates with the EMA
+        params when enabled).
     """
     normalize = _input_normalizer(input_norm)
 
@@ -139,7 +155,7 @@ def build_train_step(
                 train=True,
                 mutable=["batch_stats"],
             )
-            loss = cross_entropy_loss(out, label)
+            loss = cross_entropy_loss(out, label, label_smoothing)
             # Make the OBJECTIVE the global-batch mean (each replica's CE is
             # the mean over its local shard).  Differentiating this is the
             # DDP-reducer equivalent: the cotangent of the replicated params
@@ -208,8 +224,20 @@ def build_train_step(
         new_params, new_bs, new_opt, loss = sharded(
             state.params, state.batch_stats, state.opt_state, img, label
         )
+        if ema_decay is not None:
+            # replicated elementwise update — no collective needed, so it
+            # lives outside the shard_map
+            d = float(ema_decay)
+            new_ema = jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p, state.ema, new_params
+            )
+        else:
+            new_ema = state.ema
         return (
-            TrainState(params=new_params, batch_stats=new_bs, opt_state=new_opt),
+            TrainState(
+                params=new_params, batch_stats=new_bs, opt_state=new_opt,
+                ema=new_ema,
+            ),
             loss,
         )
 

@@ -34,6 +34,7 @@ def build_tp_lm_train_step(
     lr_fn: Callable,
     mesh: Mesh,
     donate: bool = True,
+    label_smoothing: float = 0.0,
 ):
     """Compile one DP x TP LM iteration (GSPMD-partitioned).
 
@@ -48,14 +49,17 @@ def build_tp_lm_train_step(
         def loss_fn(p):
             logits = model.apply({"params": p}, tokens)
             vocab = logits.shape[-1]
-            return cross_entropy_loss(logits.reshape(-1, vocab), labels.reshape(-1))
+            return cross_entropy_loss(
+                logits.reshape(-1, vocab), labels.reshape(-1), label_smoothing
+            )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         lr = lr_fn(state.opt_state.step)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
         return (
             TrainState(
-                params=new_params, batch_stats=state.batch_stats, opt_state=new_opt
+                params=new_params, batch_stats=state.batch_stats,
+                opt_state=new_opt, ema=state.ema,
             ),
             loss,
         )

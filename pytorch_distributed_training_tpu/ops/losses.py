@@ -14,31 +14,54 @@ import jax.numpy as jnp
 __all__ = ["cross_entropy_loss", "cross_entropy_loss_xla"]
 
 
-def cross_entropy_loss_xla(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def cross_entropy_loss_xla(
+    logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
+) -> jnp.ndarray:
     """Mean softmax cross-entropy with integer labels (plain XLA lowering).
 
-    Matches ``torch.nn.CrossEntropyLoss`` defaults (mean reduction, no label
-    smoothing).  Computed in float32 regardless of the (possibly bf16) logits
-    dtype — the reference's AMP-era convention, and numerically required for
-    a stable logsumexp on TPU.
+    Matches ``torch.nn.CrossEntropyLoss`` (mean reduction; optional
+    ``label_smoothing`` with torch's convention: the target distribution is
+    ``(1-s)`` on the true class + ``s/C`` uniform, giving
+    ``loss = logz - (1-s)*true_logit - (s/C)*sum(logits)``).  Computed in
+    float32 regardless of the (possibly bf16) logits dtype — the reference's
+    AMP-era convention, and numerically required for a stable logsumexp on
+    TPU.
     """
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     true_logit = jnp.take_along_axis(logits, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if label_smoothing:
+        s = float(label_smoothing)
+        mean_logit = jnp.mean(logits, axis=-1)
+        return jnp.mean(logz - (1.0 - s) * true_logit - s * mean_logit)
     return jnp.mean(logz - true_logit)
 
 
-def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+def cross_entropy_loss(
+    logits: jnp.ndarray, labels: jnp.ndarray, label_smoothing: float = 0.0
+) -> jnp.ndarray:
     """Mean softmax CE — Pallas-fused on TPU, XLA lowering elsewhere.
 
     Same semantics either way (see :func:`cross_entropy_loss_xla`); the
     fused kernel (:mod:`.fused_ce`) does the row-wise softmax pipeline in
     one VMEM pass, forward and backward.  ``PDT_DISABLE_PALLAS=1`` forces
     the XLA path (checked at trace time — both paths compile to static
-    programs).
+    programs).  With ``label_smoothing`` the uniform-target correction term
+    (cheap, fuses into the surrounding graph) rides on top of the fused
+    hard-target CE.
     """
     if jax.default_backend() == "tpu" and not os.environ.get("PDT_DISABLE_PALLAS"):
         from .fused_ce import fused_cross_entropy
 
-        return fused_cross_entropy(logits, labels)
-    return cross_entropy_loss_xla(logits, labels)
+        hard = fused_cross_entropy(logits, labels)
+        if label_smoothing:
+            # smooth = hard + s*(true_logit - mean_logit), averaged: derive
+            # the correction from the logits directly (f32, one cheap pass)
+            s = float(label_smoothing)
+            lg = logits.astype(jnp.float32)
+            true_logit = jnp.take_along_axis(
+                lg, labels[:, None].astype(jnp.int32), axis=-1
+            )[:, 0]
+            hard = hard + s * jnp.mean(true_logit - jnp.mean(lg, axis=-1))
+        return hard
+    return cross_entropy_loss_xla(logits, labels, label_smoothing)
