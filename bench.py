@@ -71,9 +71,11 @@ def bench_loader():
         _make_jpeg_tree(root, n_images)
         ds = get_dataset("imagenet", root, "train")
         sampler = RandomSampler(len(ds), seed=0)
+        dct = int(os.environ.get("BENCH_DCT_DENOM", "1"))
         loader = DataLoader(
             ds, batch_size=batch, sampler=sampler, num_workers=workers,
             drop_last=True, worker_mode=os.environ.get("BENCH_LOADER_MODE", "auto"),
+            dct_denom=dct,
         )
         # warm epoch (page cache, native lib load, pool spin-up)
         for _ in loader:
@@ -90,7 +92,7 @@ def bench_loader():
         json.dumps(
             {
                 "metric": f"host input-pipeline images/sec ({loader.worker_mode} mode, "
-                f"{workers} workers, {cores} cores)",
+                f"dct_denom={dct}, {workers} workers, {cores} cores)",
                 "value": round(img_per_sec, 1),
                 "unit": "images/sec/host",
                 "vs_baseline": round(img_per_sec / A100_DDP_IMG_PER_SEC, 3),
@@ -187,13 +189,14 @@ def bench_e2e():
         for _ in range(3):
             g_img, g_lab = next(stream)
             state, loss = train_step(state, g_img, g_lab)
-        jax.block_until_ready(loss)
+        float(loss)  # real sync (block_until_ready can return early
+        # through the remote-device transport)
         iters = int(os.environ.get("BENCH_ITERS", "12"))
         t0 = time.perf_counter()
         for _ in range(iters):
             g_img, g_lab = next(stream)
             state, loss = train_step(state, g_img, g_lab)
-        jax.block_until_ready(loss)
+        float(loss)
         dt = time.perf_counter() - t0
         loader.close()
 
@@ -208,6 +211,103 @@ def bench_e2e():
                 "value": round(v, 1),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(v / A100_DDP_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+def bench_lm():
+    """TransformerLM training-step throughput (tokens/sec/chip, bf16).
+
+    GPT-2-medium-ish shapes by default; override with BENCH_LM_* env vars.
+    MFU uses the standard 6*N*T approximation (N = non-embedding params,
+    T = tokens) plus the attention term 12*L*H*S^2*D.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import (
+        TrainState,
+        build_lm_train_step,
+    )
+    from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+    from pytorch_distributed_training_tpu.optimizers import AdamW
+    from pytorch_distributed_training_tpu.parallel import (
+        make_sp_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import cosine_lr
+
+    vocab = int(os.environ.get("BENCH_LM_VOCAB", "32768"))
+    seq = int(os.environ.get("BENCH_LM_SEQ", "2048"))
+    # per-chip, like BENCH_BATCH in the other modes; the data axis spans all
+    # chips so the global batch must scale with the device count
+    batch = int(os.environ.get("BENCH_LM_BATCH", "4")) * jax.device_count()
+    embed = int(os.environ.get("BENCH_LM_EMBED", "1024"))
+    depth = int(os.environ.get("BENCH_LM_DEPTH", "16"))
+    heads = int(os.environ.get("BENCH_LM_HEADS", "16"))
+
+    mesh = make_sp_mesh(sequence_parallelism=1)
+    # remat: a ~330M-param LM at seq 2048 doesn't fit 16GB HBM with stored
+    # block activations + AdamW moments; rematerialization is how this
+    # model class actually trains (config: model.remat)
+    lm = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=embed, depth=depth,
+        num_heads=heads, remat=True, dtype=jnp.bfloat16,
+    )
+    opt = AdamW(lr=3e-4, weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :seq]))["params"]
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = build_lm_train_step(lm, opt, cosine_lr(3e-4, 100000), mesh)
+    inp = jax.device_put(jnp.asarray(tokens[:, :-1]), replicated_sharding(mesh))
+    lab = jax.device_put(jnp.asarray(tokens[:, 1:]), replicated_sharding(mesh))
+
+    for _ in range(3):
+        state, loss = step(state, inp, lab)
+    float(loss)  # scalar materialization: a real device sync (see below)
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, inp, lab)
+    # sync via host materialization of the loss, NOT block_until_ready: the
+    # chained state dependency forces every step to have executed, whereas
+    # block_until_ready has been observed to return early through the
+    # remote-device transport (under-reporting multi-step loops ~250x)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_per_sec = batch * seq * iters / dt / jax.device_count()
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    # N for the 6N term excludes embedding tables (their forward is a
+    # gather, not a matmul; the untied output head IS a matmul and stays)
+    n_matmul = n_params - sum(
+        leaf.size
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        if any("embedding" in str(getattr(k, "key", k)) for k in path)
+    )
+    # fwd+bwd FLOPs/token: 6*N + 12*L*S*E (attention QK^T+PV, causal halves
+    # the S but bwd doubles again — standard estimate)
+    flops_tok = 6 * n_matmul + 12 * depth * seq * embed
+    kind = jax.devices()[0].device_kind
+    peak = {"TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+            "TPU v4": 275e12, "TPU v6e": 918e12}.get(kind)
+    fl_sec = tok_per_sec * flops_tok
+    print(
+        json.dumps(
+            {
+                "metric": f"TransformerLM {n_params/1e6:.0f}M train tokens/sec/chip "
+                f"(bfloat16, seq {seq}, batch {batch // jax.device_count()}/chip)",
+                "value": round(tok_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": None,
+                "device": kind,
+                "step_ms": round(dt / iters * 1e3, 1),
+                "tflops_per_sec": round(fl_sec / 1e12, 1),
+                "mfu_pct": round(100 * fl_sec / peak, 1) if peak else None,
             }
         )
     )
@@ -264,13 +364,13 @@ def main():
     # warmup: compile + 2 steps
     for _ in range(3):
         state, loss = train_step(state, img, label)
-    jax.block_until_ready(loss)
-
+    float(loss)  # real sync (block_until_ready can return early through
+    # the remote-device transport; the chained state forces execution)
     iters = int(os.environ.get("BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = train_step(state, img, label)
-    jax.block_until_ready(loss)
+    float(loss)
     dt = time.perf_counter() - t0
 
     img_per_sec_chip = batch * iters / dt / n_chips
@@ -307,5 +407,7 @@ if __name__ == "__main__":
         bench_loader()
     elif mode == "e2e":
         bench_e2e()
+    elif mode == "lm":
+        bench_lm()
     else:
         main()

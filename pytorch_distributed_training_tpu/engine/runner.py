@@ -356,6 +356,17 @@ class Runner:
             if self.device_normalize
             else None
         )
+        # Additive key ``training.dct_denom``: libjpeg DCT-domain pre-scale
+        # for the native decoder (1 = exact full decode, 2/4/8 = fixed,
+        # 0 = auto-pick the largest that keeps the crop >= output size —
+        # large speedup on big photos at a small resampling-fidelity cost).
+        # TRAINING loader only: validation always decodes at full fidelity
+        # so eval metrics stay comparable across dct settings.
+        dct_denom = int(train_cfg.get("dct_denom", 1))
+        if dct_denom not in (0, 1, 2, 4, 8):
+            raise ValueError(
+                f"training.dct_denom must be 0 (auto), 1, 2, 4, or 8; got {dct_denom}"
+            )
         self.train_loader = train_loader = DataLoader(
             train_dataset,
             batch_size=host_batch,
@@ -364,6 +375,7 @@ class Runner:
             drop_last=True,
             worker_mode=worker_mode,
             output_dtype=output_dtype,
+            dct_denom=dct_denom,
         )
         # Parity: val loader reuses TRAINING batch/workers (:235-241).
         self.val_loader = DataLoader(

@@ -29,7 +29,12 @@ from ..utils.vma import mark_varying
 
 __all__ = ["fused_cross_entropy"]
 
-_TILE_B = 128  # batch rows per kernel instance; lane dim carries the classes
+_TILE_B = 128  # max batch rows per kernel instance; lane dim carries classes
+_TILE_BYTES = 2 * 1024 * 1024  # f32 logits-tile budget: scoped VMEM is
+# ~16MB and the backward pipelines double-buffered input AND output tiles
+# (4 tile-sized buffers) plus temporaries, so cap the tile at ~2MB and
+# shrink the row count for large class counts (LM vocabularies) instead of
+# overflowing VMEM
 
 
 def _out_struct(shape, dtype, like):
@@ -75,8 +80,12 @@ def _bwd_kernel(logits_ref, labels_ref, lse_ref, scale_ref, dlogits_ref, *, vma_
     dlogits_ref[...] = ((p - onehot) * scale_ref[0]).astype(dlogits_ref.dtype)
 
 
-def _tile(b: int) -> int:
-    return min(_TILE_B, b)
+def _tile(b: int, c: int) -> int:
+    budget_rows = max(1, _TILE_BYTES // (4 * c))
+    tile = 1
+    while tile * 2 <= min(_TILE_B, budget_rows):
+        tile *= 2
+    return min(tile, b)
 
 
 @functools.lru_cache(maxsize=None)
@@ -94,7 +103,7 @@ def _make(interpret: bool):
 
     def _forward(logits, labels):
         b, c = logits.shape
-        tile = _tile(b)
+        tile = _tile(b, c)
         labels2 = labels.astype(jnp.int32).reshape(b, 1)
         nll, lse = pl.pallas_call(
             functools.partial(_fwd_kernel, vma_axes=_kernel_vma(logits)),
@@ -127,7 +136,7 @@ def _make(interpret: bool):
     def ce_bwd(res, g):
         logits, labels, lse = res
         b, c = logits.shape
-        tile = _tile(b)
+        tile = _tile(b, c)
         labels2 = labels.astype(jnp.int32).reshape(b, 1)
         # fold the mean's 1/B into the upstream cotangent once, on the host side
         scale = (g / b).astype(jnp.float32).reshape(1)
