@@ -185,11 +185,10 @@ class Runner:
                 "training.sequence_parallelism / tensor_parallelism require "
                 "model.name: TransformerLM"
             )
-        if self.seq_par > 1 and self.tensor_par > 1:
-            raise ValueError(
-                "sequence_parallelism and tensor_parallelism cannot be "
-                "combined yet — pick one (a 3-axis mesh is a follow-up)"
-            )
+        # seq_par alone -> shard_map ring attention (memory-optimal for long
+        # context); tensor_par (with or without seq_par) -> the GSPMD path
+        # on a (data, sequence, model) mesh, where the partitioner inserts
+        # the sequence resharding around attention (tp_steps.py).
         if self.is_lm:
             for key, par in (
                 ("sequence_parallelism", self.seq_par),
@@ -203,6 +202,15 @@ class Runner:
                         f"training.{key} ({par}) must divide the local "
                         f"device count ({jax.local_device_count()})"
                     )
+            if jax.local_device_count() % (self.seq_par * self.tensor_par) != 0:
+                # combined: one data shard spans a seq_par x tensor_par
+                # device group — the whole group must fit within a host or
+                # units_local becomes 0 and the host batch degenerates
+                raise ValueError(
+                    f"sequence_parallelism x tensor_parallelism "
+                    f"({self.seq_par} x {self.tensor_par}) must divide the "
+                    f"local device count ({jax.local_device_count()})"
+                )
             sample_inp, _ = train_dataset[0]
             self.seq_len = int(sample_inp.shape[0])
             if self.seq_len % self.seq_par != 0:
@@ -211,7 +219,9 @@ class Runner:
                     f"training.sequence_parallelism ({self.seq_par})"
                 )
             model_cfg.setdefault("max_len", self.seq_len)
-            if self.seq_par > 1:
+            if self.seq_par > 1 and self.tensor_par == 1:
+                # ring-attention path only; the GSPMD path keeps
+                # seq_axis=None and lets the partitioner distribute
                 model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
             self.model = get_model(
                 model_name,
@@ -245,11 +255,9 @@ class Runner:
             raise ValueError(
                 f"training.batch_division must be 'local' or 'world', got {division!r}"
             )
-        # Batch rows shard over the DATA axis only; under sequence/tensor
-        # parallelism each group of seq_par (or tensor_par) devices holds one
-        # batch shard, so the division unit is a data shard, not a device.
-        # (seq_par and tensor_par are mutually exclusive, so the product is
-        # whichever is active.)
+        # Batch rows shard over the DATA axis only; each data shard spans a
+        # seq_par x tensor_par device group (either may be 1), so the
+        # division unit is a data shard, not a device.
         non_data = self.seq_par * self.tensor_par if self.is_lm else 1
         units_local = local_devices // non_data
         units_world = self.world_size // non_data
@@ -397,9 +405,12 @@ class Runner:
 
         # --- mesh + compiled steps + replicated state -----------------------
         if self.is_lm and self.tensor_par > 1:
-            # (data, model) mesh, GSPMD Megatron sharding (parallel/tensor):
-            # params live sharded over the model axis; XLA inserts the
-            # row-parallel all-reduces and the gradient all-reduce itself
+            # (data, sequence, model) mesh, GSPMD Megatron sharding
+            # (parallel/tensor): params live sharded over the model axis;
+            # XLA inserts the row-parallel all-reduces, the gradient
+            # all-reduce, and — when sequence_parallelism > 1 — the
+            # sequence resharding around attention
+            from ..parallel import make_3d_mesh
             from ..parallel.tensor import tp_state_shardings
             from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step
 
@@ -409,7 +420,7 @@ class Runner:
                     f"model.num_heads ({self.model.num_heads}) must be "
                     f"divisible by training.tensor_parallelism ({self.tensor_par})"
                 )
-            self.mesh = make_mesh(model_parallelism=self.tensor_par)
+            self.mesh = make_3d_mesh(self.seq_par, self.tensor_par)
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
             state = TrainState(
@@ -423,7 +434,9 @@ class Runner:
                 label_smoothing=self.label_smoothing,
             )(self.state)
             self.eval_step = build_tp_lm_eval_step(self.model, self.mesh)(self.state)
-            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            tok_sharding = NamedSharding(
+                self.mesh, P(DATA_AXIS, SEQUENCE_AXIS)
+            )
             self._img_sharding = tok_sharding
             self._label_sharding = tok_sharding
         elif self.is_lm:

@@ -10,8 +10,14 @@ boundaries).  This is the scaling-book recipe verbatim: pick a mesh,
 annotate, let XLA do the communication scheduling.
 
 The same :class:`TransformerLM` module (seq_axis=None) is used — TP here
-composes with DP; combining TP with ring-attention SP on a 3-axis mesh is a
-follow-up that slots into the same builder.
+composes with DP, and — on a 3-D ``(data, sequence, model)`` mesh
+(``parallel.make_3d_mesh``) — with GSPMD sequence parallelism too: token
+inputs shard over BOTH the data and sequence axes and the partitioner
+inserts the sequence resharding around attention (DeepSpeed-Ulysses-style
+all-to-alls fall out of the sharding propagation).  The shard_map-based
+ring-attention path (``sp_steps``) remains the memory-optimal choice for
+SP-only long-context runs; this GSPMD path is what composes all three
+axes in one program.
 """
 from __future__ import annotations
 
@@ -22,8 +28,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
+from ..parallel.sequence import SEQUENCE_AXIS
 from ..parallel.tensor import tp_state_shardings
 from .steps import TrainState
+
+
+def _token_spec(mesh: Mesh) -> P:
+    """Tokens shard over data (+ sequence, when the mesh carries that axis)."""
+    if SEQUENCE_AXIS in mesh.axis_names:
+        return P(DATA_AXIS, SEQUENCE_AXIS)
+    return P(DATA_AXIS, None)
 
 __all__ = ["build_tp_lm_train_step", "build_tp_lm_eval_step"]
 
@@ -67,7 +81,7 @@ def build_tp_lm_train_step(
     def compile_for(state: TrainState):
         """jit with shardings derived from this state's structure."""
         state_sh = tp_state_shardings(state, mesh)
-        tok_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        tok_sh = NamedSharding(mesh, _token_spec(mesh))
         rep = NamedSharding(mesh, P())
         return jax.jit(
             step,
@@ -100,7 +114,7 @@ def build_tp_lm_eval_step(model, mesh: Mesh):
 
     def compile_for(state: TrainState):
         state_sh = tp_state_shardings(state, mesh)
-        tok_sh = NamedSharding(mesh, P(DATA_AXIS, None))
+        tok_sh = NamedSharding(mesh, _token_spec(mesh))
         rep = NamedSharding(mesh, P())
         return jax.jit(
             step,

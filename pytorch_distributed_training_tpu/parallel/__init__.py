@@ -15,6 +15,7 @@ from .mesh import (
     batch_pspec,
     batch_sharding,
     make_mesh,
+    make_3d_mesh,
     make_sp_mesh,
     replicated_sharding,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "initialize_distributed",
     "parse_dist_url",
     "make_mesh",
+    "make_3d_mesh",
     "make_sp_mesh",
     "batch_sharding",
     "batch_pspec",

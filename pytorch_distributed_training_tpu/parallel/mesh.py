@@ -22,6 +22,7 @@ __all__ = [
     "MODEL_AXIS",
     "make_mesh",
     "make_sp_mesh",
+    "make_3d_mesh",
     "batch_sharding",
     "batch_pspec",
     "replicated_sharding",
@@ -31,12 +32,12 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
-def _make_2d_mesh(
-    second_axis_size: int,
-    second_axis_name: str,
+def _make_nd_mesh(
+    inner_sizes: Sequence[int],
+    inner_names: Sequence[str],
     devices: Optional[Sequence],
 ) -> Mesh:
-    """Shared builder for ``(data, <axis>)`` meshes.
+    """Shared builder: data axis outermost + the given inner axes.
 
     ``mesh_utils.create_device_mesh`` orders the full device set for ICI
     adjacency; explicit device subsets fall back to a plain reshape.
@@ -44,16 +45,20 @@ def _make_2d_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if n % second_axis_size != 0:
+    inner = 1
+    for s in inner_sizes:
+        inner *= s
+    if inner == 0 or n % inner != 0:
         raise ValueError(
-            f"{n} devices not divisible by {second_axis_name} size {second_axis_size}"
+            f"{n} devices not divisible by "
+            + " x ".join(f"{nm} ({s})" for nm, s in zip(inner_names, inner_sizes))
         )
-    shape = (n // second_axis_size, second_axis_size)
+    shape = (n // inner, *inner_sizes)
     if n == jax.device_count() and list(devices) == jax.devices():
         dev_array = mesh_utils.create_device_mesh(shape)
     else:
         dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, (DATA_AXIS, second_axis_name))
+    return Mesh(dev_array, (DATA_AXIS, *inner_names))
 
 
 def make_mesh(devices: Optional[Sequence] = None, model_parallelism: int = 1) -> Mesh:
@@ -65,7 +70,7 @@ def make_mesh(devices: Optional[Sequence] = None, model_parallelism: int = 1) ->
       model_parallelism: size of the model axis (1 = pure DP, the reference's
         only strategy).
     """
-    return _make_2d_mesh(model_parallelism, MODEL_AXIS, devices)
+    return _make_nd_mesh((model_parallelism,), (MODEL_AXIS,), devices)
 
 
 def make_sp_mesh(
@@ -79,7 +84,29 @@ def make_sp_mesh(
     """
     from .sequence import SEQUENCE_AXIS
 
-    return _make_2d_mesh(sequence_parallelism, SEQUENCE_AXIS, devices)
+    return _make_nd_mesh((sequence_parallelism,), (SEQUENCE_AXIS,), devices)
+
+
+def make_3d_mesh(
+    sequence_parallelism: int,
+    model_parallelism: int,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """3-D ``(data, sequence, model)`` mesh — DP x SP x TP composition.
+
+    Axis order keeps the model (TP) axis innermost: Megatron's per-layer
+    all-reduces are the highest-frequency collectives, so they get the
+    tightest ICI neighborhoods from ``mesh_utils`` ordering; sequence
+    (context) next; data outermost (lowest-frequency gradient reduction,
+    free to cross DCN at pod scale).  Any axis may be size 1.
+    """
+    from .sequence import SEQUENCE_AXIS
+
+    return _make_nd_mesh(
+        (sequence_parallelism, model_parallelism),
+        (SEQUENCE_AXIS, MODEL_AXIS),
+        devices,
+    )
 
 
 def batch_pspec(ndim: int) -> P:

@@ -200,7 +200,7 @@ def test_runner_lm_tensor_parallel_adamw_end_to_end():
     }
     runner, tb = _run(cfg)
     assert runner.is_lm and runner.tensor_par == 4
-    assert runner.mesh.shape == {"data": 2, "model": 4}
+    assert runner.mesh.shape == {"data": 2, "sequence": 1, "model": 4}
     assert runner.iter == 6
     # params actually live sharded over the model axis
     import jax as _jax
@@ -217,19 +217,23 @@ def test_runner_lm_tensor_parallel_adamw_end_to_end():
     assert accs and all(0.0 <= a <= 100.0 for a in accs)
 
 
-def test_sp_and_tp_are_mutually_exclusive():
-    cfg = _lm_cfg(
-        2,
-        {
-            "name": "synthetic_text",
-            "root": "/unused",
-            "n_classes": 64,
-            "seq_len": 32,
-            "n_samples": 96,
-        },
-    )
-    cfg["training"]["tensor_parallelism"] = 2
-    with pytest.raises(ValueError, match="cannot be combined"):
+def test_lm_parallelism_validation():
+    base = {
+        "name": "synthetic_text",
+        "root": "/unused",
+        "n_classes": 64,
+        "seq_len": 30,  # NOT divisible by 4
+        "n_samples": 96,
+    }
+    cfg = _lm_cfg(4, dict(base))
+    with pytest.raises(ValueError, match="seq_len"):
+        _run(cfg)
+    cfg = _lm_cfg(3, dict(base))  # 3 does not divide 8 local devices
+    with pytest.raises(ValueError, match="divide"):
+        _run(cfg)
+    cfg = _lm_cfg(1, dict(base, seq_len=32))
+    cfg["training"]["tensor_parallelism"] = 8  # heads=4 < tp=8
+    with pytest.raises(ValueError, match="num_heads"):
         _run(cfg)
 
 
@@ -303,3 +307,36 @@ def test_runner_lm_checkpoint_resume(tmp_path):
         [np.asarray(x).ravel() for x in __import__("jax").tree.leaves(runner2.state.params)]
     )
     np.testing.assert_array_equal(first_digest, second_digest)
+
+
+def test_runner_lm_sp_tp_combined_end_to_end():
+    """sequence_parallelism: 2 x tensor_parallelism: 2 from the config
+    (DPx2 x SPx2 x TPx2 GSPMD on the 3-axis mesh) through the Runner."""
+    cfg = _lm_cfg(
+        2,
+        {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 96,
+        },
+    )
+    cfg["training"]["tensor_parallelism"] = 2
+    runner, tb = _run(cfg)
+    assert runner.is_lm and runner.seq_par == 2 and runner.tensor_par == 2
+    assert runner.mesh.shape == {"data": 2, "sequence": 2, "model": 2}
+    assert runner.model.seq_axis is None  # GSPMD path, not ring attention
+    assert runner.iter == 6
+    import jax as _jax
+
+    sharded = [
+        leaf
+        for leaf in _jax.tree.leaves(runner.state.params)
+        if not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded, "combined run must have model-axis-sharded params"
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert np.isfinite(losses).all()
+    accs = [v for t, v, _ in tb.scalars if t == "eval/Acc@1"]
+    assert accs and all(0.0 <= a <= 100.0 for a in accs)
