@@ -186,9 +186,18 @@ class Runner:
                 "model.name: TransformerLM"
             )
         # seq_par alone -> shard_map ring attention (memory-optimal for long
-        # context); tensor_par (with or without seq_par) -> the GSPMD path
-        # on a (data, sequence, model) mesh, where the partitioner inserts
-        # the sequence resharding around attention (tp_steps.py).
+        # context); tensor_par or zero (with or without seq_par) -> the GSPMD
+        # path on a (data, sequence, model) mesh, where the partitioner
+        # inserts the sequence resharding around attention (tp_steps.py).
+        # Additive key ``training.zero``: ZeRO-1 optimizer-state sharding
+        # over the data axis (GSPMD LM path; parallel/tensor.py).  Parsed
+        # here because it changes BOTH the path selection below and the
+        # model's attention mode.
+        self.zero = bool(train_cfg.get("zero", False))
+        if self.zero and not self.is_lm:
+            raise ValueError(
+                "training.zero is only wired for the LM task (GSPMD path)"
+            )
         if self.is_lm:
             for key, par in (
                 ("sequence_parallelism", self.seq_par),
@@ -219,9 +228,10 @@ class Runner:
                     f"training.sequence_parallelism ({self.seq_par})"
                 )
             model_cfg.setdefault("max_len", self.seq_len)
-            if self.seq_par > 1 and self.tensor_par == 1:
-                # ring-attention path only; the GSPMD path keeps
-                # seq_axis=None and lets the partitioner distribute
+            if self.seq_par > 1 and self.tensor_par == 1 and not self.zero:
+                # ring-attention path only; the GSPMD path (tensor_par or
+                # zero) keeps seq_axis=None and lets the partitioner
+                # distribute — a seq_axis model requires shard_map
                 model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
             self.model = get_model(
                 model_name,
@@ -267,9 +277,10 @@ class Runner:
         self.grad_accum = int(train_cfg.get("grad_accumulation", 1))
         if self.grad_accum < 1:
             raise ValueError(f"grad_accumulation must be >= 1, got {self.grad_accum}")
-        if self.grad_accum > 1 and self.tensor_par > 1:
+        if self.grad_accum > 1 and (self.tensor_par > 1 or self.zero):
             raise ValueError(
-                "grad_accumulation is not supported with tensor_parallelism yet"
+                "grad_accumulation is not supported on the GSPMD LM path "
+                "(tensor_parallelism / zero) yet"
             )
         # Additive keys: torch-convention label smoothing + params EMA
         # (evaluation runs with the EMA weights when enabled).
@@ -404,12 +415,14 @@ class Runner:
         )
 
         # --- mesh + compiled steps + replicated state -----------------------
-        if self.is_lm and self.tensor_par > 1:
+        if self.is_lm and (self.tensor_par > 1 or self.zero):
             # (data, sequence, model) mesh, GSPMD Megatron sharding
             # (parallel/tensor): params live sharded over the model axis;
             # XLA inserts the row-parallel all-reduces, the gradient
             # all-reduce, and — when sequence_parallelism > 1 — the
-            # sequence resharding around attention
+            # sequence resharding around attention.  ``training.zero``
+            # additionally shards optimizer moments over the data axis
+            # (ZeRO-1) and selects this GSPMD path even at tensor_par == 1
             from ..parallel import make_3d_mesh
             from ..parallel.tensor import tp_state_shardings
             from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step
@@ -428,12 +441,16 @@ class Runner:
                 batch_stats={},
                 opt_state=self.optimizer.init(params),
             )
-            self.state = jax.device_put(state, tp_state_shardings(state, self.mesh))
+            self.state = jax.device_put(
+                state, tp_state_shardings(state, self.mesh, zero=self.zero)
+            )
             self.train_step = build_tp_lm_train_step(
                 self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
-                label_smoothing=self.label_smoothing,
+                label_smoothing=self.label_smoothing, zero=self.zero,
             )(self.state)
-            self.eval_step = build_tp_lm_eval_step(self.model, self.mesh)(self.state)
+            self.eval_step = build_tp_lm_eval_step(
+                self.model, self.mesh, zero=self.zero
+            )(self.state)
             tok_sharding = NamedSharding(
                 self.mesh, P(DATA_AXIS, SEQUENCE_AXIS)
             )

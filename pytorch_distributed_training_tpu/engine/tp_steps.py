@@ -49,6 +49,7 @@ def build_tp_lm_train_step(
     mesh: Mesh,
     donate: bool = True,
     label_smoothing: float = 0.0,
+    zero: bool = False,
 ):
     """Compile one DP x TP LM iteration (GSPMD-partitioned).
 
@@ -80,7 +81,7 @@ def build_tp_lm_train_step(
 
     def compile_for(state: TrainState):
         """jit with shardings derived from this state's structure."""
-        state_sh = tp_state_shardings(state, mesh)
+        state_sh = tp_state_shardings(state, mesh, zero=zero)
         tok_sh = NamedSharding(mesh, _token_spec(mesh))
         rep = NamedSharding(mesh, P())
         return jax.jit(
@@ -93,7 +94,7 @@ def build_tp_lm_train_step(
     return compile_for
 
 
-def build_tp_lm_eval_step(model, mesh: Mesh):
+def build_tp_lm_eval_step(model, mesh: Mesh, zero: bool = False):
     """Compile the TP LM validation step (GSPMD-partitioned).
 
     Same contract as the other eval steps — replicated ``(loss, acc1,
@@ -113,7 +114,7 @@ def build_tp_lm_eval_step(model, mesh: Mesh):
         return loss, acc1, acc5
 
     def compile_for(state: TrainState):
-        state_sh = tp_state_shardings(state, mesh)
+        state_sh = tp_state_shardings(state, mesh, zero=zero)
         tok_sh = NamedSharding(mesh, _token_spec(mesh))
         rep = NamedSharding(mesh, P())
         return jax.jit(

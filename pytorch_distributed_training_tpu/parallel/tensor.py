@@ -62,25 +62,47 @@ def lm_tp_shardings(params, mesh: Mesh):
     )
 
 
-def tp_state_shardings(state, mesh: Mesh):
+def tp_state_shardings(state, mesh: Mesh, zero: bool = False):
     """Shardings for a ``TrainState``: per-parameter optimizer moments
     (SGD momentum, AdamW mu/nu, ...) mirror their parameter's sharding.
 
     Generic over the optimizer: any opt_state NamedTuple field whose pytree
     structure matches ``params`` is treated as a parameter mirror; scalar
     fields (step counters) stay replicated.
+
+    ``zero``: ZeRO-1-style optimizer-state sharding — moment tensors are
+    ADDITIONALLY sharded over the ``data`` axis on their first dimension
+    (when divisible), cutting per-device optimizer memory by the data-axis
+    size.  The update math is unchanged: the GSPMD partitioner
+    reduce-scatters the gradients into the sharded moment update and
+    all-gathers the fresh params (config ``training.zero``).
     """
     from ..engine.steps import TrainState  # avoid import cycle at module load
+    from .mesh import DATA_AXIS
 
     assert isinstance(state, TrainState)
     param_sh = lm_tp_shardings(state.params, mesh)
     rep = NamedSharding(mesh, P())
+    n_data = mesh.shape[DATA_AXIS]
+
+    def zero_shard(sh, leaf):
+        spec = list(sh.spec) + [None] * (leaf.ndim - len(sh.spec))
+        if spec and spec[0] is None and leaf.shape[0] % n_data == 0:
+            spec[0] = DATA_AXIS
+            return NamedSharding(mesh, P(*spec))
+        return sh
+
+    moment_sh = (
+        jax.tree.map(zero_shard, param_sh, state.params)
+        if zero and n_data > 1
+        else param_sh
+    )
     params_struct = jax.tree.structure(state.params)
     fields = {}
     for name in state.opt_state._fields:
         field = getattr(state.opt_state, name)
         if jax.tree.structure(field) == params_struct:
-            fields[name] = param_sh
+            fields[name] = moment_sh
         else:
             fields[name] = jax.tree.map(lambda _: rep, field)
     opt_sh = type(state.opt_state)(**fields)

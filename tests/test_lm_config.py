@@ -340,3 +340,95 @@ def test_runner_lm_sp_tp_combined_end_to_end():
     assert np.isfinite(losses).all()
     accs = [v for t, v, _ in tb.scalars if t == "eval/Acc@1"]
     assert accs and all(0.0 <= a <= 100.0 for a in accs)
+
+
+def test_runner_lm_zero_end_to_end():
+    """training.zero: ZeRO-1 moment sharding from the config; selects the
+    GSPMD path even at tensor_parallelism 1 (data axis 8)."""
+    cfg = _lm_cfg(
+        1,
+        {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 96,
+        },
+    )
+    cfg["training"]["zero"] = True
+    cfg["training"]["optimizer"] = {"name": "AdamW", "lr": 1.0e-3, "weight_decay": 0.01}
+    runner, tb = _run(cfg)
+    assert runner.zero
+    assert runner.mesh.shape == {"data": 8, "sequence": 1, "model": 1}
+    import jax as _jax
+
+    def _uses_data(sh):
+        return any(
+            e == "data" or (isinstance(e, tuple) and "data" in e) for e in sh.spec
+        )
+
+    assert any(
+        _uses_data(leaf.sharding)
+        for leaf in _jax.tree.leaves(runner.state.opt_state.mu)
+    )
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert np.isfinite(losses).all()
+
+
+def test_runner_lm_zero_with_sequence_parallelism():
+    """zero + sequence_parallelism routes the GSPMD path (seq_axis=None),
+    not ring attention — the combination must compile and run."""
+    cfg = _lm_cfg(
+        2,
+        {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 96,
+        },
+    )
+    cfg["training"]["zero"] = True
+    runner, tb = _run(cfg)
+    assert runner.zero and runner.seq_par == 2
+    assert runner.model.seq_axis is None  # GSPMD, not shard_map ring
+    assert runner.mesh.shape == {"data": 4, "sequence": 2, "model": 1}
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert np.isfinite(losses).all()
+
+
+def test_runner_image_grad_accumulation_end_to_end(tmp_path):
+    """training.grad_accumulation through the Runner on the image path
+    (regression: the config guard must not touch unset LM-only state)."""
+    cfg = {
+        "dataset": {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 8,
+            "image_size": 32,
+            "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {"name": "SGD", "lr": 0.01, "weight_decay": 1.0e-4, "momentum": 0.9},
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": 3,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": True,
+            "grad_accumulation": 2,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+    tb = _FakeTB()
+    runner = Runner(
+        num_nodes=1, rank=0, seed=1029, dist_url="tcp://127.0.0.1:9942",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: tb,
+    )
+    runner()
+    assert runner.iter == 3 and runner.grad_accum == 2
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert losses and np.isfinite(losses).all()
