@@ -86,10 +86,16 @@ def tp_state_shardings(state, mesh: Mesh, zero: bool = False):
     n_data = mesh.shape[DATA_AXIS]
 
     def zero_shard(sh, leaf):
+        # shard the first FREE dimension (spec None + divisible): for
+        # column-parallel kernels that is dim 0; for row-parallel kernels
+        # (P(model, None)) dim 0 carries the model axis, so dim 1 takes the
+        # data sharding — without this, ~40% of per-block moment memory
+        # would silently stay unsharded under ZeRO + TP
         spec = list(sh.spec) + [None] * (leaf.ndim - len(sh.spec))
-        if spec and spec[0] is None and leaf.shape[0] % n_data == 0:
-            spec[0] = DATA_AXIS
-            return NamedSharding(mesh, P(*spec))
+        for d in range(leaf.ndim):
+            if spec[d] is None and leaf.shape[d] % n_data == 0:
+                spec[d] = DATA_AXIS
+                return NamedSharding(mesh, P(*spec))
         return sh
 
     moment_sh = (

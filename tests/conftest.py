@@ -32,3 +32,10 @@ def pytest_configure(config):
         "markers", "slow: long-running multi-process subprocess tests"
     )
 
+
+def uses_mesh_axis(sharding, axis: str) -> bool:
+    """True if a NamedSharding's spec references ``axis`` (shared test helper)."""
+    return any(
+        e == axis or (isinstance(e, tuple) and axis in e) for e in sharding.spec
+    )
+

@@ -71,14 +71,32 @@ class _RecordingRunner(Runner):
 
 
 def main():
-    cfg = {
-        "dataset": {
+    task = os.environ.get("MH_TASK", "image")
+    if task == "lm":
+        # multi-process long-context path: token dataset + TransformerLM,
+        # tokens sharded over the (data, sequence) axes across processes
+        dataset = {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 128,
+        }
+        model = {"name": "TransformerLM", "embed_dim": 32, "depth": 2,
+                 "num_heads": 4}
+        extra = {"sequence_parallelism": int(os.environ.get("MH_SEQ_PAR", "1"))}
+    else:
+        dataset = {
             "name": "synthetic",
             "root": "/unused",
             "n_classes": 8,
             "image_size": 32,
             "n_samples": 128,
-        },
+        }
+        model = {"name": "ResNet18"}
+        extra = {}
+    cfg = {
+        "dataset": dataset,
         "training": {
             "optimizer": {
                 "name": "SGD",
@@ -95,11 +113,12 @@ def main():
             "val_interval": 100,  # is_val still fires on the last iter (p3)
             "batch_size": 16,
             "num_workers": 2,
-            "sync_bn": True,
+            "sync_bn": task != "lm",
             "batch_division": os.environ.get("MH_BATCH_DIVISION", "world"),
+            **extra,
         },
         "validation": {"batch_size": 16, "num_workers": 2},
-        "model": {"name": "ResNet18"},
+        "model": model,
     }
     tb = _RecordingTB()
     runner = _RecordingRunner(

@@ -32,7 +32,6 @@ def test_cli_crash_path_cleans_tb_only(tmp_path):
     cfg.write_text(_BAD_CFG)
     log_dir = tmp_path / "run"
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
     env.update(
         JAX_PLATFORMS="cpu",
         XLA_FLAGS="--xla_force_host_platform_device_count=8",

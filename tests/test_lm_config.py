@@ -362,13 +362,10 @@ def test_runner_lm_zero_end_to_end():
     assert runner.mesh.shape == {"data": 8, "sequence": 1, "model": 1}
     import jax as _jax
 
-    def _uses_data(sh):
-        return any(
-            e == "data" or (isinstance(e, tuple) and "data" in e) for e in sh.spec
-        )
+    from conftest import uses_mesh_axis
 
     assert any(
-        _uses_data(leaf.sharding)
+        uses_mesh_axis(leaf.sharding, "data")
         for leaf in _jax.tree.leaves(runner.state.opt_state.mu)
     )
     losses = [v for t, v, _ in tb.scalars if t == "loss/train"]

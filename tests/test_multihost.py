@@ -49,7 +49,8 @@ def _clean_env() -> dict:
     return env
 
 
-def _launch(rank, num_nodes, port, out, local_devices, division="world"):
+def _launch(rank, num_nodes, port, out, local_devices, division="world",
+            task="image", seq_par=1):
     env = _clean_env()
     env.update(
         MH_RANK=str(rank),
@@ -58,6 +59,8 @@ def _launch(rank, num_nodes, port, out, local_devices, division="world"):
         MH_OUT=out,
         MH_LOCAL_DEVICES=str(local_devices),
         MH_BATCH_DIVISION=division,
+        MH_TASK=task,
+        MH_SEQ_PAR=str(seq_par),
     )
     # log to a FILE, not a pipe: ranks are waited on sequentially, and an
     # unread sibling pipe filling the OS buffer would block that rank
@@ -86,11 +89,13 @@ def _wait(proc, what, timeout=900):
     assert proc.returncode == 0, f"{what} failed (rc={proc.returncode}):\n{out}"
 
 
-def _run_topology_once(tmp_path, tag, n_procs, local_devices, division):
+def _run_topology_once(tmp_path, tag, n_procs, local_devices, division,
+                       task="image", seq_par=1):
     port = _free_port()
     outs = [str(tmp_path / f"{tag}_rank{r}.json") for r in range(n_procs)]
     procs = [
-        _launch(r, n_procs, port, outs[r], local_devices, division)
+        _launch(r, n_procs, port, outs[r], local_devices, division,
+                task=task, seq_par=seq_par)
         for r in range(n_procs)
     ]
     try:
@@ -105,9 +110,11 @@ def _run_topology_once(tmp_path, tag, n_procs, local_devices, division):
     return outs
 
 
-def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
+def _run_topology(tmp_path, tag, n_procs, local_devices, division="world",
+                  task="image", seq_par=1):
     try:
-        outs = _run_topology_once(tmp_path, tag, n_procs, local_devices, division)
+        outs = _run_topology_once(tmp_path, tag, n_procs, local_devices,
+                                  division, task, seq_par)
     except AssertionError as e:
         # _free_port releases the probe socket before the workers rebind it —
         # another process can steal the port in that window; retry once on a
@@ -117,7 +124,8 @@ def _run_topology(tmp_path, tag, n_procs, local_devices, division="world"):
         ).lower():
             raise
         outs = _run_topology_once(
-            tmp_path, tag + "_retry", n_procs, local_devices, division
+            tmp_path, tag + "_retry", n_procs, local_devices, division,
+            task, seq_par
         )
     results = []
     for o in outs:
@@ -178,3 +186,22 @@ def test_two_process_local_division_scales_global_batch(tmp_path):
     assert two[0]["global_batch"] == 32
     assert two[0]["param_bytes_digest"] == two[1]["param_bytes_digest"]
     assert np.isfinite(two[0]["losses"]).all()
+
+
+@pytest.mark.slow
+def test_two_process_lm_ring_sp(tmp_path):
+    """Multi-process long-context path: 2 processes x 4 devices, DPx2 x SPx4
+    ring attention, tokens assembled from per-host shards — the replicated
+    LM state must agree bitwise across ranks and match the single-process
+    run to float tolerance (same global sample sets via world division)."""
+    two = _run_topology(
+        tmp_path, "lm", n_procs=2, local_devices=4, task="lm", seq_par=4
+    )
+    one = _run_topology(
+        tmp_path, "lm1", n_procs=1, local_devices=8, task="lm", seq_par=4
+    )
+    r0, r1 = two
+    assert r0["process_count"] == 2 and r0["global_batch"] == 16
+    assert r0["param_bytes_digest"] == r1["param_bytes_digest"]
+    np.testing.assert_allclose(r0["losses"][:2], one[0]["losses"][:2], rtol=1e-4)
+    np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=2e-2)

@@ -200,15 +200,22 @@ def test_zero1_sharded_moments_match_plain():
         jax.tree_util.tree_leaves(s_zero.params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
-    def _uses_data_axis(sharding):
-        return any(
-            e == "data" or (isinstance(e, tuple) and "data" in e)
-            for e in sharding.spec
-        )
+    from conftest import uses_mesh_axis
 
-    sharded_over_data = [
-        leaf
-        for leaf in jax.tree_util.tree_leaves(s_zero.opt_state.mu)
-        if _uses_data_axis(leaf.sharding)
-    ]
+    mu_leaves = jax.tree_util.tree_leaves(s_zero.opt_state.mu)
+    sharded_over_data = [l for l in mu_leaves if uses_mesh_axis(l.sharding, "data")]
     assert sharded_over_data, "ZeRO must shard moment leaves over the data axis"
+    # with TP active, even the row-parallel (proj/fc2) KERNEL moments shard
+    # over data on a free dimension; the only legitimately unsharded leaves
+    # are the model-sharded 1-D biases (qkv/fc1 bias: P(model), no free dim)
+    flat_mu = {
+        "/".join(str(getattr(k, "key", k)) for k in path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(s_zero.opt_state.mu)[0]
+    }
+    for name in ("block0/attn/proj/kernel", "block0/mlp/fc2/kernel"):
+        assert uses_mesh_axis(flat_mu[name].sharding, "data"), name
+    unsharded = {n for n, l in flat_mu.items() if not uses_mesh_axis(l.sharding, "data")}
+    assert unsharded <= {
+        f"{b}/{n}" for b in ("block0", "block1")
+        for n in ("attn/qkv/bias", "mlp/fc1/bias")
+    }, unsharded
