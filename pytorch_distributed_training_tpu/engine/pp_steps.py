@@ -1,0 +1,300 @@
+"""Compiled pipeline-parallel (DP x PP) LM training step.
+
+GPipe microbatch schedule as ONE ``shard_map``-ed XLA program on a
+``(data, stage)`` mesh — see :mod:`..parallel.pipeline` for the layout and
+the exactness argument.  The reference has no pipeline axis at all
+(SURVEY.md §2.4); this composes with data parallelism the same way the SP
+and TP steps do and plugs into the same ``Runner`` contract.
+
+Design notes (TPU/XLA):
+  - the tick loop is a ``lax.scan`` (static trip count ``M + S - 1``), so
+    the whole schedule — including the bubble — compiles once; no Python
+    per-tick dispatch.
+  - inter-stage transfer is a single ``ppermute`` per tick over the
+    ``stage`` axis (nearest-neighbor ICI DMA), which XLA overlaps with the
+    next tick's compute where the dependence allows.
+  - under SPMD every stage runs the same program, so embedding and head
+    math execute on all stages each tick and the unused results are masked
+    out.  The head is NOT negligible at large vocab (at the shipped
+    TransformerLM-pp.yml scale it is ~40% of a stage's per-tick FLOPs) —
+    but because stages advance in lockstep (each tick ends at the
+    ppermute), per-tick wall time is set by the last stage, which must pay
+    the head anyway; the redundant copies burn energy, not time.  The
+    standard remedy when it matters is rebalancing (fewer blocks on the
+    last stage), which the stacked-layer layout does not support yet.
+    What is never duplicated: the blocks — each stage applies only its own
+    layer shard.
+  - tick inputs are index-clipped to real microbatches (never garbage), so
+    bubble ticks compute on valid data and masking alone guarantees
+    correctness — no NaN-through-``where`` hazards.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer_lm import DecoderBlock
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.pipeline import STAGE_AXIS, pp_param_specs
+from ..parallel.tensor import mirror_opt_fields
+from ..utils.vma import mark_varying
+from .sp_steps import lm_loss_local
+from .steps import TrainState
+
+__all__ = ["build_pp_lm_train_step", "build_pp_lm_eval_step"]
+
+
+def _stage_applies(model):
+    """(embed, blocks, head) closures over a TransformerLM's hyperparams.
+
+    Reuses the model's own flax modules for the shared pieces so the math is
+    bit-identical to ``TransformerLM.__call__`` (models/transformer_lm.py).
+    """
+    block = DecoderBlock(
+        num_heads=model.num_heads,
+        mlp_ratio=model.mlp_ratio,
+        seq_axis=None,
+        seq_impl=model.seq_impl,
+        dtype=model.dtype,
+    )
+    ln = nn.LayerNorm(dtype=model.dtype)
+    head = nn.Dense(model.vocab_size, dtype=jnp.float32)
+
+    def embed(shared, tokens):
+        x = jnp.take(shared["tok_embedding"], tokens, axis=0).astype(model.dtype)
+        pe = shared["pos_embedding"][: tokens.shape[1]]
+        return x + pe[None].astype(model.dtype)
+
+    def apply_blocks(blocks_local, x):
+        def layer(x, p):
+            return block.apply({"params": p}, x), None
+
+        f = jax.checkpoint(layer) if model.remat else layer
+        x, _ = jax.lax.scan(f, x, blocks_local)
+        return x
+
+    def apply_head(shared, x):
+        h = ln.apply({"params": shared["ln"]}, x)
+        return head.apply({"params": shared["head"]}, h)
+
+    return embed, apply_blocks, apply_head
+
+
+def _schedule(n_micro: int, n_stages: int):
+    """Static GPipe tick schedule: (feed index, emit index, emit mask).
+
+    Tick ``t``: stage 0 ingests microbatch ``t`` (clipped — re-feeding the
+    last microbatch during drain ticks keeps the data real), the last stage
+    finishes microbatch ``t - (S-1)``; its loss only counts once ``t`` has
+    passed the fill bubble.
+    """
+    ticks = np.arange(n_micro + n_stages - 1)
+    feed_idx = np.clip(ticks, 0, n_micro - 1)
+    emit_idx = np.clip(ticks - (n_stages - 1), 0, n_micro - 1)
+    emit_valid = ticks >= n_stages - 1
+    return (
+        jnp.asarray(feed_idx, jnp.int32),
+        jnp.asarray(emit_idx, jnp.int32),
+        jnp.asarray(emit_valid),
+    )
+
+
+def build_pp_lm_train_step(
+    model,
+    optimizer,
+    lr_fn: Callable,
+    mesh: Mesh,
+    num_microbatches: int,
+    donate: bool = True,
+    label_smoothing: float = 0.0,
+):
+    """Compile one DP x PP LM iteration.
+
+    ``model``: a :class:`TransformerLM` (``seq_axis=None``); its params must
+    be in the pipeline layout (:func:`..parallel.pipeline.pp_stack_params`).
+    The optimizer must be elementwise per-leaf (SGD / AdamW — LARS computes
+    per-parameter norms, which would span the stacked layer axis and change
+    semantics; the Runner rejects that combination).
+
+    Returns ``compile_for(state)`` pinning the state's stage shardings,
+    mirroring :func:`..engine.tp_steps.build_tp_lm_train_step`.
+    """
+    n_stages = mesh.shape[STAGE_AXIS]
+    n_data = mesh.shape[DATA_AXIS]
+    M = int(num_microbatches)
+    if M < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    embed, apply_blocks, apply_head = _stage_applies(model)
+    feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
+
+    def body(params, opt_state, tokens, labels):
+        b_local, seq = tokens.shape
+        if b_local % M != 0:
+            raise ValueError(
+                f"per-shard batch {b_local} not divisible by "
+                f"num_microbatches {M}"
+            )
+        mb = b_local // M
+        global_tokens = b_local * seq * n_data
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        tok = tokens.reshape(M, mb, seq)
+        lab = labels.reshape(M, mb, seq)
+        perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+        def loss_fn(p):
+            def tick(carry, xs):
+                x, loss_acc = carry
+                f_i, e_i, valid = xs
+                inj = embed(p["shared"], tok[f_i])
+                x_in = jnp.where(stage == 0, inj, x)
+                y = apply_blocks(p["blocks"], x_in)
+                logits = apply_head(p["shared"], y)
+                part = lm_loss_local(
+                    logits, lab[e_i], global_tokens, label_smoothing
+                )
+                is_last = stage == n_stages - 1
+                loss_acc = loss_acc + jnp.where(valid & is_last, part, 0.0)
+                x_next = jax.lax.ppermute(y, STAGE_AXIS, perm)
+                return (x_next, loss_acc), None
+
+            # the carry is device-varying (each stage holds a different
+            # activation), so the constant initial carry must be promoted
+            x0, l0 = mark_varying(
+                (jnp.zeros((mb, seq, model.embed_dim), model.dtype),
+                 jnp.float32(0.0)),
+                (DATA_AXIS, STAGE_AXIS),
+            )
+            (_, loss_sum), _ = jax.lax.scan(
+                tick, (x0, l0), (feed_idx, emit_idx, emit_valid)
+            )
+            # global mean CE as a replicated scalar: only the last stage
+            # holds nonzero partials, the psum both totals them over data
+            # and broadcasts over stage — differentiating THIS is what makes
+            # the pipeline backward exact (module docstring)
+            return jax.lax.psum(loss_sum, (DATA_AXIS, STAGE_AXIS))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        lr = lr_fn(opt_state.step)
+        new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+        return new_params, new_opt, loss
+
+    def compile_for(state: TrainState):
+        param_spec = pp_param_specs(state.params)
+        opt_spec = _opt_specs(state, param_spec)
+        tok_spec = P(DATA_AXIS, None)
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_spec, opt_spec, tok_spec, tok_spec),
+            out_specs=(param_spec, opt_spec, P()),
+        )
+
+        def step(state: TrainState, tokens, labels):
+            new_params, new_opt, loss = sharded(
+                state.params, state.opt_state, tokens, labels
+            )
+            return (
+                TrainState(
+                    params=new_params, batch_stats=state.batch_stats,
+                    opt_state=new_opt, ema=state.ema,
+                ),
+                loss,
+            )
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    return compile_for
+
+
+def _opt_specs(state: TrainState, param_spec):
+    """Spec pytree for the optimizer state: params-shaped moment fields
+    mirror the param specs, scalars replicate."""
+    return mirror_opt_fields(state.opt_state, state.params, param_spec, P())
+
+
+def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
+    """Compile the DP x PP LM validation step.
+
+    Same replicated ``(loss, acc1, acc5)`` contract as every other eval step
+    (mean CE per token + next-token top-1/top-5), so ``Runner.validate``
+    drives it unchanged.  Runs the same microbatch schedule forward-only.
+    """
+    import math
+
+    n_stages = mesh.shape[STAGE_AXIS]
+    n_data = mesh.shape[DATA_AXIS]
+    M_cfg = int(num_microbatches)
+    embed, apply_blocks, apply_head = _stage_applies(model)
+
+    def body(params, tokens, labels):
+        b_local, seq = tokens.shape
+        # the val loader keeps its ragged tail batch (drop_last=False,
+        # reference :219-222), so unlike the train step this must accept
+        # any per-shard batch: fall back to the largest microbatch count
+        # that divides it (a tail batch recompiles anyway — new shape)
+        M = math.gcd(M_cfg, b_local)
+        feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
+        mb = b_local // M
+        global_tokens = b_local * seq * n_data
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        tok = tokens.reshape(M, mb, seq)
+        lab = labels.reshape(M, mb, seq)
+        perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+        def tick(carry, xs):
+            x, loss_acc, c1, c5 = carry
+            f_i, e_i, valid = xs
+            inj = embed(params["shared"], tok[f_i])
+            x_in = jnp.where(stage == 0, inj, x)
+            y = apply_blocks(params["blocks"], x_in)
+            logits = apply_head(params["shared"], y)
+            part = lm_loss_local(logits, lab[e_i], global_tokens)
+            flat = logits.reshape(-1, logits.shape[-1])
+            flab = lab[e_i].reshape(-1)
+            top5 = jax.lax.top_k(flat, 5)[1]
+            hit1 = jnp.sum(top5[:, 0] == flab)
+            hit5 = jnp.sum(jnp.any(top5 == flab[:, None], axis=1))
+            emit_mask = valid & (stage == n_stages - 1)
+            loss_acc = loss_acc + jnp.where(emit_mask, part, 0.0)
+            c1 = c1 + jnp.where(emit_mask, hit1, 0)
+            c5 = c5 + jnp.where(emit_mask, hit5, 0)
+            x_next = jax.lax.ppermute(y, STAGE_AXIS, perm)
+            return (x_next, loss_acc, c1, c5), None
+
+        carry0 = mark_varying(
+            (jnp.zeros((mb, seq, model.embed_dim), model.dtype),
+             jnp.float32(0.0), jnp.int32(0), jnp.int32(0)),
+            (DATA_AXIS, STAGE_AXIS),
+        )
+        (_, loss_sum, c1, c5), _ = jax.lax.scan(
+            tick, carry0, (feed_idx, emit_idx, emit_valid)
+        )
+        axes = (DATA_AXIS, STAGE_AXIS)
+        loss = jax.lax.psum(loss_sum, axes)
+        total = jnp.float32(global_tokens)
+        acc1 = jax.lax.psum(c1, axes).astype(jnp.float32) / total * 100.0
+        acc5 = jax.lax.psum(c5, axes).astype(jnp.float32) / total * 100.0
+        return loss, acc1, acc5
+
+    def compile_for(state: TrainState):
+        param_spec = pp_param_specs(state.params)
+        tok_spec = P(DATA_AXIS, None)
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_spec, tok_spec, tok_spec),
+            out_specs=(P(), P(), P()),
+        )
+
+        @jax.jit
+        def eval_step(state: TrainState, tokens, labels):
+            return sharded(state.params, tokens, labels)
+
+        return eval_step
+
+    return compile_for

@@ -180,10 +180,36 @@ class Runner:
         )
         self.seq_par = int(train_cfg.get("sequence_parallelism", 1))
         self.tensor_par = int(train_cfg.get("tensor_parallelism", 1))
-        if (self.seq_par > 1 or self.tensor_par > 1) and not self.is_lm:
+        # Additive key ``training.pipeline_parallelism``: GPipe microbatch
+        # pipeline over a (data, stage) mesh (parallel/pipeline.py,
+        # engine/pp_steps.py).  ``training.microbatches`` tunes the schedule
+        # (default = stage count; the bubble fraction is (S-1)/(M+S-1)).
+        self.pipe_par = int(train_cfg.get("pipeline_parallelism", 1))
+        self.microbatches = int(train_cfg.get("microbatches", self.pipe_par))
+        if "microbatches" in train_cfg and self.pipe_par <= 1:
+            # silently ignoring the key would read as "microbatch streaming
+            # enabled" — grad_accumulation is the non-pipelined equivalent
             raise ValueError(
-                "training.sequence_parallelism / tensor_parallelism require "
-                "model.name: TransformerLM"
+                "training.microbatches requires pipeline_parallelism > 1 "
+                "(use training.grad_accumulation for non-pipelined "
+                "micro-batching)"
+            )
+        if (
+            self.seq_par > 1 or self.tensor_par > 1 or self.pipe_par > 1
+        ) and not self.is_lm:
+            raise ValueError(
+                "training.sequence_parallelism / tensor_parallelism / "
+                "pipeline_parallelism require model.name: TransformerLM"
+            )
+        if self.pipe_par > 1 and (self.seq_par > 1 or self.tensor_par > 1):
+            raise ValueError(
+                "pipeline_parallelism does not compose with "
+                "sequence/tensor parallelism yet"
+            )
+        if self.microbatches < max(self.pipe_par, 1):
+            raise ValueError(
+                f"training.microbatches ({self.microbatches}) must be >= "
+                f"pipeline_parallelism ({self.pipe_par})"
             )
         # seq_par alone -> shard_map ring attention (memory-optimal for long
         # context); tensor_par or zero (with or without seq_par) -> the GSPMD
@@ -198,10 +224,17 @@ class Runner:
             raise ValueError(
                 "training.zero is only wired for the LM task (GSPMD path)"
             )
+        if self.zero and self.pipe_par > 1:
+            # the PP layout already stage-shards the moments; ZeRO's
+            # data-axis moment sharding is a different layout contract
+            raise ValueError(
+                "training.zero does not compose with pipeline_parallelism"
+            )
         if self.is_lm:
             for key, par in (
                 ("sequence_parallelism", self.seq_par),
                 ("tensor_parallelism", self.tensor_par),
+                ("pipeline_parallelism", self.pipe_par),
             ):
                 if par < 1 or jax.local_device_count() % par != 0:
                     # the host-batch layout (and
@@ -211,14 +244,16 @@ class Runner:
                         f"training.{key} ({par}) must divide the local "
                         f"device count ({jax.local_device_count()})"
                     )
-            if jax.local_device_count() % (self.seq_par * self.tensor_par) != 0:
-                # combined: one data shard spans a seq_par x tensor_par
+            non_data_par = self.seq_par * self.tensor_par * self.pipe_par
+            if jax.local_device_count() % non_data_par != 0:
+                # combined: one data shard spans a seq x tensor x pipe
                 # device group — the whole group must fit within a host or
                 # units_local becomes 0 and the host batch degenerates
                 raise ValueError(
-                    f"sequence_parallelism x tensor_parallelism "
-                    f"({self.seq_par} x {self.tensor_par}) must divide the "
-                    f"local device count ({jax.local_device_count()})"
+                    f"sequence_parallelism x tensor_parallelism x "
+                    f"pipeline_parallelism ({self.seq_par} x {self.tensor_par}"
+                    f" x {self.pipe_par}) must divide the local device count "
+                    f"({jax.local_device_count()})"
                 )
             sample_inp, _ = train_dataset[0]
             self.seq_len = int(sample_inp.shape[0])
@@ -268,7 +303,9 @@ class Runner:
         # Batch rows shard over the DATA axis only; each data shard spans a
         # seq_par x tensor_par device group (either may be 1), so the
         # division unit is a data shard, not a device.
-        non_data = self.seq_par * self.tensor_par if self.is_lm else 1
+        non_data = (
+            self.seq_par * self.tensor_par * self.pipe_par if self.is_lm else 1
+        )
         units_local = local_devices // non_data
         units_world = self.world_size // non_data
         # Additive key ``training.grad_accumulation``: per-step micro-batch
@@ -281,6 +318,12 @@ class Runner:
             raise ValueError(
                 "grad_accumulation is not supported on the GSPMD LM path "
                 "(tensor_parallelism / zero) yet"
+            )
+        if self.grad_accum > 1 and self.pipe_par > 1:
+            raise ValueError(
+                "grad_accumulation is redundant under pipeline_parallelism — "
+                "raise training.microbatches instead (same memory effect, "
+                "and it also shrinks the pipeline bubble)"
             )
         # Additive keys: torch-convention label smoothing + params EMA
         # (evaluation runs with the EMA weights when enabled).
@@ -318,6 +361,11 @@ class Runner:
             raise ValueError(
                 f"per-shard batch ({per_device_batch}) not divisible by "
                 f"training.grad_accumulation ({self.grad_accum})"
+            )
+        if self.pipe_par > 1 and per_device_batch % self.microbatches != 0:
+            raise ValueError(
+                f"per-shard batch ({per_device_batch}) not divisible by "
+                f"training.microbatches ({self.microbatches})"
             )
         # One controller per host: cfg num_workers = decode threads per host
         # (the reference divides workers among its per-GPU processes, :195 —
@@ -415,7 +463,54 @@ class Runner:
         )
 
         # --- mesh + compiled steps + replicated state -----------------------
-        if self.is_lm and (self.tensor_par > 1 or self.zero):
+        if self.is_lm and self.pipe_par > 1:
+            # (data, stage) mesh, GPipe microbatch schedule as one shard_map
+            # program (parallel/pipeline.py, engine/pp_steps.py): decoder
+            # blocks stack into a leading layer axis sharded over stage,
+            # activations rotate stage-to-stage via ppermute each tick.
+            from ..optimizers import LARS
+            from ..parallel import (
+                make_pp_mesh,
+                pp_stack_params,
+                pp_state_shardings,
+            )
+            from .pp_steps import build_pp_lm_eval_step, build_pp_lm_train_step
+
+            if self.model.depth % self.pipe_par != 0:
+                raise ValueError(
+                    f"model.depth ({self.model.depth}) must be divisible by "
+                    f"training.pipeline_parallelism ({self.pipe_par})"
+                )
+            if isinstance(self.optimizer, LARS):
+                # LARS takes per-parameter norms; on the stacked layer axis
+                # those would span a whole stage's layers — different math
+                raise ValueError(
+                    "optimizer LARS is not supported with "
+                    "pipeline_parallelism (per-parameter trust ratios do not "
+                    "survive the stacked-layer param layout)"
+                )
+            self.mesh = make_pp_mesh(self.pipe_par)
+            sample = jnp.zeros((1, self.seq_len), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            pp_params = pp_stack_params(params, self.model.depth)
+            state = TrainState(
+                params=pp_params,
+                batch_stats={},
+                opt_state=self.optimizer.init(pp_params),
+            )
+            self.state = jax.device_put(state, pp_state_shardings(state, self.mesh))
+            self.train_step = build_pp_lm_train_step(
+                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
+                num_microbatches=self.microbatches,
+                label_smoothing=self.label_smoothing,
+            )(self.state)
+            self.eval_step = build_pp_lm_eval_step(
+                self.model, self.mesh, self.microbatches
+            )(self.state)
+            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            self._img_sharding = tok_sharding
+            self._label_sharding = tok_sharding
+        elif self.is_lm and (self.tensor_par > 1 or self.zero):
             # (data, sequence, model) mesh, GSPMD Megatron sharding
             # (parallel/tensor): params live sharded over the model axis;
             # XLA inserts the row-parallel all-reduces, the gradient

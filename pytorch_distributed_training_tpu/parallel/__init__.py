@@ -19,6 +19,14 @@ from .mesh import (
     make_sp_mesh,
     replicated_sharding,
 )
+from .pipeline import (
+    STAGE_AXIS,
+    make_pp_mesh,
+    pp_param_specs,
+    pp_stack_params,
+    pp_state_shardings,
+    pp_unstack_params,
+)
 from .sequence import SEQUENCE_AXIS, ring_attention, ulysses_attention
 from .tensor import lm_tp_param_specs, lm_tp_shardings, tp_state_shardings
 
@@ -28,12 +36,18 @@ __all__ = [
     "make_mesh",
     "make_3d_mesh",
     "make_sp_mesh",
+    "make_pp_mesh",
     "batch_sharding",
     "batch_pspec",
     "replicated_sharding",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQUENCE_AXIS",
+    "STAGE_AXIS",
     "ring_attention",
     "ulysses_attention",
+    "pp_stack_params",
+    "pp_unstack_params",
+    "pp_param_specs",
+    "pp_state_shardings",
 ]

@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe-style microbatch streaming over a stage axis.
+
+The fourth parallelism axis (after data, sequence, model).  The reference
+has no pipeline sharding of any kind (whole-model replication,
+train_distributed.py:189,198; SURVEY.md §2.4 lists PP as absent) — this is
+a beyond-parity capability, built the TPU-native way: the whole pipeline
+schedule is ONE compiled SPMD program under ``shard_map`` over a
+``(data, stage)`` mesh.
+
+Layout.  The transformer's decoder blocks are homogeneous, so their params
+stack into one pytree with a leading ``[depth, ...]`` layer axis; sharding
+that axis over ``stage`` gives each device a contiguous group of
+``depth / n_stages`` layers (a pipeline stage) with NO resharding of the
+math inside a stage.  Embedding / final-LN / head params stay replicated
+over ``stage`` (their FLOPs are negligible next to the blocks; replication
+avoids the classic first/last-stage special cases).
+
+Schedule.  Microbatches flow through the stages in the GPipe pattern:
+``n_micro + n_stages - 1`` ticks of a ``lax.scan``; each tick every stage
+applies its layer group to its current activation, then a single
+``ppermute`` rotates activations one hop along the stage axis — a
+nearest-neighbor ICI DMA, the same primitive ring attention uses
+(``parallel.sequence``).  Stage 0 injects the next microbatch's embeddings;
+the last stage computes logits + the masked partial loss.  Bubble fraction
+is the usual ``(S-1)/(M+S-1)``; raise ``n_micro`` to amortize.
+
+Gradients are exact by the same argument as the SP step (engine/sp_steps):
+the objective is the global-mean loss as a replicated scalar (psum over
+data AND stage of per-microbatch partial sums), so differentiating through
+the scan + ppermutes yields the true global gradient — ppermute transposes
+to the reverse rotation (activation cotangents ride the ring backwards,
+exactly pipeline backward), stage-sharded block params get local grads, and
+shard_map's AD transpose psums the replicated (embed/head) cotangents.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import _make_nd_mesh
+from .tensor import mirror_opt_fields
+
+__all__ = [
+    "STAGE_AXIS",
+    "make_pp_mesh",
+    "pp_stack_params",
+    "pp_unstack_params",
+    "pp_param_specs",
+    "pp_state_shardings",
+]
+
+STAGE_AXIS = "stage"
+
+
+def make_pp_mesh(
+    pipeline_parallelism: int, devices: Optional[Sequence] = None
+) -> Mesh:
+    """2-D ``(data, stage)`` mesh.  ``mesh_utils`` ordering keeps successive
+    stages ICI-adjacent so the per-tick activation ``ppermute`` is a
+    nearest-neighbor hop."""
+    return _make_nd_mesh((pipeline_parallelism,), (STAGE_AXIS,), devices)
+
+
+def pp_stack_params(params, depth: int):
+    """Re-layout a :class:`TransformerLM` params tree for the pipeline step.
+
+    ``{block0..block{L-1}, tok_embedding, pos_embedding, ln, head}`` →
+    ``{"blocks": <leading-[L] stacked tree>, "shared": <the rest>}``.
+    The stacked layer axis is what ``pp_param_specs`` shards over ``stage``.
+    """
+    blocks = [params[f"block{i}"] for i in range(depth)]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    shared = {k: v for k, v in params.items() if not k.startswith("block")}
+    return {"blocks": stacked, "shared": shared}
+
+
+def pp_unstack_params(pp_params, depth: int):
+    """Inverse of :func:`pp_stack_params` (e.g. for export / weight port)."""
+    out = dict(pp_params["shared"])
+    for i in range(depth):
+        out[f"block{i}"] = jax.tree.map(lambda x: x[i], pp_params["blocks"])
+    return out
+
+
+def pp_param_specs(pp_params):
+    """PartitionSpec pytree: blocks shard their layer axis over ``stage``,
+    shared params replicate."""
+    return {
+        "blocks": jax.tree.map(lambda _: P(STAGE_AXIS), pp_params["blocks"]),
+        "shared": jax.tree.map(lambda _: P(), pp_params["shared"]),
+    }
+
+
+def pp_state_shardings(state, mesh: Mesh):
+    """Shardings for a pipeline ``TrainState``: optimizer moment trees that
+    mirror the params structure take the params' specs (stage-sharded
+    moments for stage-sharded layers), scalar fields stay replicated."""
+    from ..engine.steps import TrainState  # avoid import cycle at module load
+
+    assert isinstance(state, TrainState)
+    rep = NamedSharding(mesh, P())
+    # derive from pp_param_specs so the layout rule has a single source of
+    # truth shared with the compiled step's shard_map specs (pp_steps)
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        pp_param_specs(state.params),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    opt_sh = mirror_opt_fields(state.opt_state, state.params, param_sh, rep)
+    bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
+    return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)

@@ -31,7 +31,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import MODEL_AXIS
 
-__all__ = ["lm_tp_param_specs", "lm_tp_shardings", "tp_state_shardings"]
+__all__ = [
+    "lm_tp_param_specs",
+    "lm_tp_shardings",
+    "tp_state_shardings",
+    "mirror_opt_fields",
+]
+
+
+def mirror_opt_fields(opt_state, params, param_tree, rep):
+    """Rebuild an optimizer-state NamedTuple with per-field value trees:
+    fields whose pytree structure matches ``params`` (moment trees — SGD
+    momentum, AdamW mu/nu, ...) take ``param_tree`` (their parameter's
+    spec/sharding), anything else (step counters) maps every leaf to
+    ``rep``.  Shared by the TP/ZeRO (:func:`tp_state_shardings`), pipeline
+    (``parallel.pipeline.pp_state_shardings``), and pipeline-step
+    (``engine.pp_steps``) sharding helpers so the structure-matching rule
+    cannot drift between them."""
+    params_struct = jax.tree.structure(params)
+    fields = {}
+    for name in opt_state._fields:
+        field = getattr(opt_state, name)
+        if jax.tree.structure(field) == params_struct:
+            fields[name] = param_tree
+        else:
+            fields[name] = jax.tree.map(lambda _: rep, field)
+    return type(opt_state)(**fields)
 
 
 def _spec_for(path) -> P:
@@ -103,14 +128,6 @@ def tp_state_shardings(state, mesh: Mesh, zero: bool = False):
         if zero and n_data > 1
         else param_sh
     )
-    params_struct = jax.tree.structure(state.params)
-    fields = {}
-    for name in state.opt_state._fields:
-        field = getattr(state.opt_state, name)
-        if jax.tree.structure(field) == params_struct:
-            fields[name] = moment_sh
-        else:
-            fields[name] = jax.tree.map(lambda _: rep, field)
-    opt_sh = type(state.opt_state)(**fields)
+    opt_sh = mirror_opt_fields(state.opt_state, state.params, moment_sh, rep)
     bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
     return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)
