@@ -175,6 +175,12 @@ class Runner:
         # ``training.sequence_parallelism`` (ring/Ulysses over a sequence
         # mesh axis, parallel.sequence).
         self.is_lm = model_name.lower() == "transformerlm"
+        # MoE (model.moe_experts > 0, ops/moe.py): trains on the GSPMD path
+        # whatever the parallelism degrees — the routing einsums and the
+        # sown aux loss need the partitioner's global-token view, and under
+        # tensor_parallelism the stacked expert weights shard over the
+        # model axis (expert parallelism).
+        self.is_moe = self.is_lm and int(model_cfg.get("moe_experts", 0) or 0) > 0
         sync_bn = (
             bool(train_cfg["sync_bn"]) and self.distributed and not self.is_lm
         )
@@ -206,6 +212,30 @@ class Runner:
                 "pipeline_parallelism does not compose with "
                 "sequence/tensor parallelism yet"
             )
+        if self.pipe_par > 1 and self.is_moe:
+            # MoE blocks break the homogeneous stacked-layer layout the
+            # pipeline step scans over, and its sown aux loss is discarded
+            # by the manual per-stage block apply
+            raise ValueError(
+                "model.moe_experts does not compose with pipeline_parallelism"
+            )
+        if self.is_moe and int(model_cfg.get("moe_experts")) % self.tensor_par != 0:
+            raise ValueError(
+                f"model.moe_experts ({model_cfg.get('moe_experts')}) must be "
+                f"divisible by training.tensor_parallelism ({self.tensor_par}) "
+                "for an even expert split"
+            )
+        if self.is_moe:
+            moe_every = int(model_cfg.get("moe_every", 2))
+            moe_depth = int(model_cfg.get("depth", 4))
+            if not 1 <= moe_every <= moe_depth:
+                # moe_every 0 would div-by-zero at init; > depth silently
+                # trains a fully dense model while every MoE restriction
+                # still applies — both are config errors, say so
+                raise ValueError(
+                    f"model.moe_every ({moe_every}) must be in [1, depth="
+                    f"{moe_depth}] (moe_every > depth would make no block MoE)"
+                )
         if self.microbatches < max(self.pipe_par, 1):
             raise ValueError(
                 f"training.microbatches ({self.microbatches}) must be >= "
@@ -263,9 +293,14 @@ class Runner:
                     f"training.sequence_parallelism ({self.seq_par})"
                 )
             model_cfg.setdefault("max_len", self.seq_len)
-            if self.seq_par > 1 and self.tensor_par == 1 and not self.zero:
+            if (
+                self.seq_par > 1
+                and self.tensor_par == 1
+                and not self.zero
+                and not self.is_moe
+            ):
                 # ring-attention path only; the GSPMD path (tensor_par or
-                # zero) keeps seq_axis=None and lets the partitioner
+                # zero or MoE) keeps seq_axis=None and lets the partitioner
                 # distribute — a seq_axis model requires shard_map
                 model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
             self.model = get_model(
@@ -314,10 +349,10 @@ class Runner:
         self.grad_accum = int(train_cfg.get("grad_accumulation", 1))
         if self.grad_accum < 1:
             raise ValueError(f"grad_accumulation must be >= 1, got {self.grad_accum}")
-        if self.grad_accum > 1 and (self.tensor_par > 1 or self.zero):
+        if self.grad_accum > 1 and (self.tensor_par > 1 or self.zero or self.is_moe):
             raise ValueError(
                 "grad_accumulation is not supported on the GSPMD LM path "
-                "(tensor_parallelism / zero) yet"
+                "(tensor_parallelism / zero / moe) yet"
             )
         if self.grad_accum > 1 and self.pipe_par > 1:
             raise ValueError(
@@ -510,14 +545,17 @@ class Runner:
             tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
             self._img_sharding = tok_sharding
             self._label_sharding = tok_sharding
-        elif self.is_lm and (self.tensor_par > 1 or self.zero):
+        elif self.is_lm and (self.tensor_par > 1 or self.zero or self.is_moe):
             # (data, sequence, model) mesh, GSPMD Megatron sharding
             # (parallel/tensor): params live sharded over the model axis;
             # XLA inserts the row-parallel all-reduces, the gradient
             # all-reduce, and — when sequence_parallelism > 1 — the
             # sequence resharding around attention.  ``training.zero``
             # additionally shards optimizer moments over the data axis
-            # (ZeRO-1) and selects this GSPMD path even at tensor_par == 1
+            # (ZeRO-1) and selects this GSPMD path even at tensor_par == 1.
+            # MoE models (``model.moe_experts``) also land here: expert
+            # weights shard over the model axis (expert parallelism) and
+            # the train step folds the sown aux loss into the objective
             from ..parallel import make_3d_mesh
             from ..parallel.tensor import tp_state_shardings
             from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step

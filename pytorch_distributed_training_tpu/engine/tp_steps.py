@@ -62,11 +62,25 @@ def build_tp_lm_train_step(
 
     def step(state: TrainState, tokens, labels):
         def loss_fn(p):
-            logits = model.apply({"params": p}, tokens)
+            # mutable="intermediates" collects sown auxiliary objectives —
+            # today the MoE load-balancing loss (ops/moe.py sows the
+            # already-weighted value under ``moe_aux``); dense models sow
+            # nothing.  Only ``moe_aux`` entries join the objective: other
+            # sown intermediates (telemetry, debugging) must NOT leak into
+            # the loss (r2 code-review finding).  Validation stays pure CE.
+            logits, inter = model.apply(
+                {"params": p}, tokens, mutable="intermediates"
+            )
             vocab = logits.shape[-1]
-            return cross_entropy_loss(
+            loss = cross_entropy_loss(
                 logits.reshape(-1, vocab), labels.reshape(-1), label_smoothing
             )
+            for path, leaf in jax.tree_util.tree_flatten_with_path(inter)[0]:
+                if any(
+                    str(getattr(key, "key", key)) == "moe_aux" for key in path
+                ):
+                    loss = loss + leaf
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         lr = lr_fn(state.opt_state.step)

@@ -36,6 +36,13 @@ class DecoderBlock(nn.Module):
     seq_axis: Optional[str]
     seq_impl: str
     dtype: Any = jnp.float32
+    # MoE (ops/moe.py): experts > 0 swaps the dense MLP for a top-k routed
+    # mixture; the residual around it means capacity-dropped tokens pass
+    # through unchanged
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @nn.compact
     def __call__(self, x):
@@ -50,6 +57,19 @@ class DecoderBlock(nn.Module):
             name="attn",
         )(y)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.moe_experts > 0:
+            from ..ops.moe import MoEMLP
+
+            return x + MoEMLP(
+                num_experts=self.moe_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                hidden=int(dim * self.mlp_ratio),
+                out=dim,
+                aux_weight=self.moe_aux_weight,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
         return x + MLP(
             hidden=int(dim * self.mlp_ratio), out=dim, dtype=self.dtype, name="mlp"
         )(y)
@@ -68,9 +88,20 @@ class TransformerLM(nn.Module):
     seq_impl: str = "ring"
     remat: bool = False
     dtype: Any = jnp.float32
+    # MoE (beyond reference; ops/moe.py): every ``moe_every``-th block uses
+    # a routed mixture of ``moe_experts`` expert MLPs (0 = dense everywhere).
+    # Expert weights stack [E, ...] and shard over the ``model`` mesh axis
+    # under training.tensor_parallelism (= expert parallelism).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+    moe_every: int = 2
 
     @nn.compact
     def __call__(self, tokens):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
         b, s = tokens.shape
         emb = self.param(
             "tok_embedding",
@@ -107,12 +138,22 @@ class TransformerLM(nn.Module):
         # unchanged, so remat toggling is checkpoint-compatible.
         block_cls = nn.remat(DecoderBlock) if self.remat else DecoderBlock
         for i in range(self.depth):
+            # GShard convention: MoE in every moe_every-th block (the
+            # (moe_every-1) offset puts the first MoE at block 1 for the
+            # default stride 2, matching the usual dense-first layout)
+            is_moe_block = (
+                self.moe_experts > 0 and i % self.moe_every == self.moe_every - 1
+            )
             x = block_cls(
                 num_heads=self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 seq_axis=self.seq_axis if not self.is_initializing() else None,
                 seq_impl=self.seq_impl,
                 dtype=self.dtype,
+                moe_experts=self.moe_experts if is_moe_block else 0,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_aux_weight=self.moe_aux_weight,
                 name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)

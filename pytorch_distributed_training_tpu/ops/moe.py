@@ -1,0 +1,131 @@
+"""Mixture-of-Experts MLP with top-k routing and expert parallelism.
+
+The reference has no MoE anywhere (SURVEY.md §2.4 lists expert parallelism
+as absent) — this is a beyond-parity capability, built the idiomatic
+XLA/GSPMD way (the GShard/Switch formulation): routing is expressed as
+dense one-hot dispatch/combine einsums over a fixed per-expert capacity,
+so the whole layer is static-shaped matmul work the MXU can tile — no
+data-dependent gather/scatter, no dynamic shapes, nothing XLA cannot
+partition.
+
+Tokens are routed in GROUPS (GShard's key memory trick): each leading
+batch row is one group, capacity is per group per expert
+(``C = ceil(capacity_factor * k * S / E)`` for group size ``S``), and the
+dispatch/combine tensors are ``[G, S, E, C]`` — linear in total tokens for
+a fixed sequence length, where whole-batch routing would be quadratic
+(the r2 code-review caught exactly that: at batch 64 x seq 2048 a global
+capacity makes dispatch ~1e14 elements; per-group it is ~5e9 bf16-able
+and shards over the data axis).
+
+Expert parallelism rides the existing ``model`` mesh axis: the expert
+weights are stacked ``[E, ...]`` and sharded on their leading dim
+(``parallel.tensor`` adds the spec rule), so under ``training.
+tensor_parallelism: N`` the SPMD partitioner places ``E/N`` experts per
+device and inserts the token all-to-alls around the expert einsums itself
+— the scaling-book recipe, not hand-written collectives.
+
+Routing semantics (standard Switch/Mixtral hybrid, all documented here
+because they are the part reviewers argue about):
+  - router logits + softmax in float32 regardless of compute dtype
+    (router numerics drive a discrete choice; bf16 ties flip experts),
+  - top-k gates renormalized to sum to 1 over the chosen k (Mixtral
+    convention; with k=1 this is Switch's single gate = its probability),
+  - slots fill token-major within each group with slot-0 (primary expert)
+    priority; tokens over capacity are DROPPED for that expert — their
+    combine weight is 0, so with the transformer's residual connection
+    they pass through unchanged (GShard behavior),
+  - aux load-balancing loss (Switch eq. 4): ``E * sum_e f_e * P_e`` over
+    ALL tokens (not per group — f and P are per-token statistics, so the
+    global form is exact and group-count independent), where ``f_e`` is
+    the fraction of tokens whose top-1 choice is expert ``e`` and ``P_e``
+    the mean router probability; sown (already weighted by ``aux_weight``)
+    into the ``intermediates`` collection under ``moe_aux`` — the train
+    step adds every ``moe_aux`` entry to the objective (engine/tp_steps).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["MoEMLP"]
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MoE replacement for ``models.vit.MLP`` (same gelu two-layer
+    experts, same ``[G, S, d] -> [G, S, out]`` contract; each leading-dim
+    row is one routing group)."""
+
+    num_experts: int
+    top_k: int
+    capacity_factor: float
+    hidden: int
+    out: int
+    aux_weight: float = 0.01
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim != 3:
+            raise ValueError(
+                f"MoEMLP expects [groups, group_size, d] inputs, got {x.shape}"
+            )
+        g, s, d = x.shape
+        E, k = self.num_experts, self.top_k
+        if not 1 <= k <= E:
+            raise ValueError(f"top_k ({k}) must be in [1, num_experts={E}]")
+
+        # ---- routing (f32) ------------------------------------------------
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # [g, s, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, s, k]
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+        cap = max(1, int(math.ceil(self.capacity_factor * k * s / E)))
+        # slot-major fill within each group: every token's primary (slot-0)
+        # choice claims buffer positions before any secondary choice does,
+        # so capacity pressure drops low-gate assignments first
+        oh = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [g, s, k, E]
+        slot_major = jnp.swapaxes(oh, 1, 2).reshape(g, k * s, E)
+        pos = jnp.cumsum(slot_major, axis=1) * slot_major - 1  # [g, k*s, E]
+        keep = (pos >= 0) & (pos < cap)
+        disp_flat = jax.nn.one_hot(pos, cap, dtype=jnp.float32) * keep[..., None]
+        disp = jnp.swapaxes(
+            disp_flat.reshape(g, k, s, E, cap), 1, 2
+        )  # [g, s, k, E, cap], 0/1, disjoint slots
+        dispatch = jnp.sum(disp, axis=2)  # [g, s, E, cap]
+        combine = jnp.sum(disp * gate_vals[:, :, :, None, None], axis=2)
+
+        # ---- aux load-balancing loss (Switch eq. 4, global over tokens) ---
+        flat_probs = probs.reshape(-1, E)
+        top1 = jax.nn.one_hot(gate_idx[:, :, 0].reshape(-1), E, dtype=jnp.float32)
+        aux = E * jnp.sum(top1.mean(axis=0) * flat_probs.mean(axis=0))
+        self.sow("intermediates", "moe_aux", self.aux_weight * aux)
+
+        # ---- expert computation (stacked [E, ...] params) -----------------
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (E, d, self.hidden), jnp.float32
+        )
+        bi = self.param("bi", nn.initializers.zeros_init(), (E, self.hidden), jnp.float32)
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(), (E, self.hidden, self.out), jnp.float32
+        )
+        bo = self.param("bo", nn.initializers.zeros_init(), (E, self.out), jnp.float32)
+
+        dt = self.dtype
+        xe = jnp.einsum("gsec,gsd->gecd", dispatch.astype(dt), x.astype(dt))
+        h = nn.gelu(
+            jnp.einsum("gecd,edh->gech", xe, wi.astype(dt))
+            + bi[None, :, None, :].astype(dt)
+        )
+        ye = (
+            jnp.einsum("gech,ehd->gecd", h, wo.astype(dt))
+            + bo[None, :, None, :].astype(dt)
+        )
+        # bias on empty capacity slots is harmless: their combine weight is 0
+        return jnp.einsum("gsec,gecd->gsd", combine.astype(dt), ye)

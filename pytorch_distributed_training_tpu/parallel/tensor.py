@@ -72,6 +72,13 @@ def _spec_for(path) -> P:
             return P(None, MODEL_AXIS) if leaf == "kernel" else P(MODEL_AXIS)
         if "fc2" in keys and leaf == "kernel":
             return P(MODEL_AXIS, None)
+    if "moe" in keys:
+        # expert parallelism: stacked [E, ...] expert weights (ops/moe.py)
+        # shard their expert dim over the model axis; the partitioner
+        # inserts the token all-to-alls around the expert einsums.  The
+        # router stays replicated (every device routes its own tokens).
+        if leaf in ("wi", "wo", "bi", "bo"):
+            return P(MODEL_AXIS)
     return P()
 
 

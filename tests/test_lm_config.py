@@ -497,3 +497,70 @@ def test_pipeline_parallelism_validation():
     cfg["training"]["optimizer"] = {"name": "LARS", "lr": 0.1, "momentum": 0.9}
     with pytest.raises(ValueError, match="LARS"):
         _run(cfg)
+
+
+def test_runner_lm_moe_expert_parallel_end_to_end():
+    """model.moe_experts from the config: MoE routes to the GSPMD path,
+    expert weights shard over the model axis (expert parallelism), and the
+    aux load-balancing loss trains end to end with finite values."""
+    cfg = _lm_cfg(
+        1,
+        {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 96,
+        },
+    )
+    cfg["training"]["sequence_parallelism"] = 1
+    cfg["training"]["tensor_parallelism"] = 4
+    cfg["model"]["moe_experts"] = 4
+    cfg["model"]["moe_top_k"] = 2
+    runner, tb = _run(cfg)
+    assert runner.is_lm and runner.is_moe and runner.tensor_par == 4
+    assert runner.mesh.shape == {"data": 2, "sequence": 1, "model": 4}
+    assert runner.iter == 6
+    import jax as _jax
+
+    wi = runner.state.params["block1"]["moe"]["wi"]
+    assert wi.sharding.spec[0] == "model"
+    losses = [v for t, v, _ in tb.scalars if t == "loss/train"]
+    assert losses and np.isfinite(losses).all()
+    accs = [v for t, v, _ in tb.scalars if t == "eval/Acc@1"]
+    assert accs and all(0.0 <= a <= 100.0 for a in accs)
+
+
+def test_moe_validation():
+    base = {
+        "name": "synthetic_text",
+        "root": "/unused",
+        "n_classes": 64,
+        "seq_len": 32,
+        "n_samples": 96,
+    }
+    # experts must split evenly over the model axis
+    cfg = _lm_cfg(1, dict(base))
+    cfg["training"]["tensor_parallelism"] = 4
+    cfg["model"]["moe_experts"] = 6
+    with pytest.raises(ValueError, match="moe_experts"):
+        _run(cfg)
+    # MoE does not compose with pipeline parallelism
+    cfg = _lm_cfg(1, dict(base))
+    cfg["training"]["pipeline_parallelism"] = 4
+    cfg["model"]["depth"] = 4
+    cfg["model"]["moe_experts"] = 4
+    with pytest.raises(ValueError, match="moe"):
+        _run(cfg)
+    # moe_every outside [1, depth] is a config error, not a silent no-op
+    cfg = _lm_cfg(1, dict(base))
+    cfg["model"]["moe_experts"] = 4
+    cfg["model"]["moe_every"] = 0
+    with pytest.raises(ValueError, match="moe_every"):
+        _run(cfg)
+    cfg = _lm_cfg(1, dict(base))
+    cfg["model"]["moe_experts"] = 4
+    cfg["model"]["depth"] = 2
+    cfg["model"]["moe_every"] = 3
+    with pytest.raises(ValueError, match="moe_every"):
+        _run(cfg)

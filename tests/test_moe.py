@@ -1,0 +1,235 @@
+"""Mixture-of-Experts + expert parallelism (ops/moe.py, parallel/tensor.py).
+
+The reference has no MoE (SURVEY.md §2.4 lists expert parallelism as
+absent); this beyond-parity capability gets the same evidence standard as
+SP/TP/PP.  The routing semantics are pinned by construction oracles
+(dense-equivalence, top-1 exactness, capacity drop, hand-computed aux
+loss), and the parallelism by the DP(2) x EP(4) == single-device equality
+through the GSPMD step — which only holds if the partitioner's token
+all-to-alls around the expert-sharded einsums are inserted correctly.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_training_tpu.engine import TrainState
+from pytorch_distributed_training_tpu.engine.tp_steps import build_tp_lm_train_step
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.ops import cross_entropy_loss
+from pytorch_distributed_training_tpu.ops.moe import MoEMLP
+from pytorch_distributed_training_tpu.optimizers import SGD
+from pytorch_distributed_training_tpu.parallel import make_mesh
+from pytorch_distributed_training_tpu.parallel.tensor import (
+    lm_tp_param_specs,
+    tp_state_shardings,
+)
+
+T, D, H, E = 24, 16, 32, 4
+
+
+def _x(seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(2, T // 2, D)).astype(np.float32))
+
+
+def _router_probs(params, xf):
+    logits = xf @ params["router"]["kernel"] + params["router"]["bias"]
+    return jax.nn.softmax(logits, -1)
+
+
+def _expert(params, e, xf):
+    h = nn.gelu(xf @ params["wi"][e] + params["bi"][e])
+    return h @ params["wo"][e] + params["bo"][e]
+
+
+def test_moe_dense_equivalence():
+    """k=E with capacity for every token reduces the routed mixture to the
+    dense convex combination sum_e p_e * expert_e(x) — the strongest whole-
+    layer oracle (dispatch, combine, and gate renormalization all pinned)."""
+    x = _x()
+    moe = MoEMLP(num_experts=E, top_k=E, capacity_factor=float(E), hidden=H, out=D)
+    params = moe.init(jax.random.PRNGKey(0), x)["params"]
+    y = moe.apply({"params": params}, x, mutable="intermediates")[0].reshape(T, D)
+    xf = x.reshape(T, D)
+    probs = _router_probs(params, xf)
+    manual = sum(
+        np.asarray(probs[:, e : e + 1]) * np.asarray(_expert(params, e, xf))
+        for e in range(E)
+    )
+    np.testing.assert_allclose(np.asarray(y), manual, atol=1e-5)
+
+
+def test_moe_top1_routing_exact():
+    """k=1 + ample capacity: every token gets exactly its argmax expert."""
+    x = _x(1)
+    moe = MoEMLP(num_experts=E, top_k=1, capacity_factor=float(E), hidden=H, out=D)
+    params = moe.init(jax.random.PRNGKey(1), x)["params"]
+    y = moe.apply({"params": params}, x, mutable="intermediates")[0].reshape(T, D)
+    xf = x.reshape(T, D)
+    sel = np.asarray(jnp.argmax(_router_probs(params, xf), -1))
+    for t in range(T):
+        np.testing.assert_allclose(
+            np.asarray(y[t]), np.asarray(_expert(params, sel[t], xf[t])), atol=1e-5
+        )
+
+
+def test_moe_capacity_drop_passthrough():
+    """capacity_factor 0.25 with k=1: capacity is PER GROUP (= leading
+    batch row, GShard grouping) — each expert keeps ceil(0.25*12/4)=1 token
+    per group; overflowed tokens get a zero layer output (the residual in
+    the transformer block then passes them through unchanged)."""
+    x = _x(2)  # 2 groups of 12 tokens
+    moe = MoEMLP(num_experts=E, top_k=1, capacity_factor=0.25, hidden=H, out=D)
+    params = moe.init(jax.random.PRNGKey(2), x)["params"]
+    y = moe.apply({"params": params}, x, mutable="intermediates")[0].reshape(T, D)
+    norms = np.linalg.norm(np.asarray(y), axis=-1)
+    kept = int((norms > 1e-7).sum())
+    assert kept <= 2 * E * 1  # groups x experts x per-group capacity
+    assert kept > 0
+    assert (norms < 1e-7).any()  # and something was actually dropped
+
+
+def test_moe_dispatch_memory_is_group_local():
+    """The r2 review's scaling finding: dispatch/combine must be
+    [G, S, E, C] with C from the GROUP size, not the global token count —
+    doubling the number of groups must leave capacity unchanged."""
+    import math as _math
+
+    moe = MoEMLP(num_experts=E, top_k=2, capacity_factor=1.0, hidden=H, out=D)
+    x2 = _x()  # 2 groups of 12
+    rng = np.random.default_rng(11)
+    x8 = jnp.asarray(rng.normal(size=(8, T // 2, D)).astype(np.float32))
+    params = moe.init(jax.random.PRNGKey(5), x2)["params"]
+    cap = _math.ceil(1.0 * 2 * (T // 2) / E)  # from group size 12, not 24/96
+    # both batch sizes run through the same params with per-group capacity:
+    # outputs for identical group content must be identical regardless of
+    # how many other groups ride along (routing is group-local)
+    y_a = moe.apply({"params": params}, x8, mutable="intermediates")[0]
+    y_b = moe.apply({"params": params}, x8[:2], mutable="intermediates")[0]
+    np.testing.assert_allclose(
+        np.asarray(y_a[:2]), np.asarray(y_b), atol=1e-6
+    )
+    assert cap == 6  # the documented formula, pinned
+
+
+def test_moe_aux_loss_oracle():
+    """The sown aux value equals aux_weight * E * sum_e f_e * P_e (Switch
+    eq. 4) computed by hand from the router probabilities."""
+    x = _x(3)
+    w = 0.37
+    moe = MoEMLP(
+        num_experts=E, top_k=2, capacity_factor=2.0, hidden=H, out=D, aux_weight=w
+    )
+    params = moe.init(jax.random.PRNGKey(3), x)["params"]
+    _, inter = moe.apply({"params": params}, x, mutable="intermediates")
+    (aux,) = jax.tree.leaves(inter)
+    probs = np.asarray(_router_probs(params, x.reshape(T, D)))
+    top1 = np.eye(E)[probs.argmax(-1)]
+    expect = w * E * float((top1.mean(0) * probs.mean(0)).sum())
+    np.testing.assert_allclose(float(aux), expect, rtol=1e-5)
+
+
+def test_moe_top_k_validation():
+    x = _x()
+    bad = MoEMLP(num_experts=E, top_k=E + 1, capacity_factor=1.0, hidden=H, out=D)
+    with pytest.raises(ValueError, match="top_k"):
+        bad.init(jax.random.PRNGKey(0), x)
+
+
+# ---------------------------------------------------------------- EP / GSPMD
+VOCAB, SEQ, BATCH = 64, 16, 8
+
+
+def _lm():
+    return TransformerLM(
+        vocab_size=VOCAB, max_len=SEQ, embed_dim=32, depth=2, num_heads=4,
+        seq_axis=None, moe_experts=E, moe_top_k=2, moe_capacity_factor=2.0,
+        moe_every=2,
+    )
+
+
+def _lm_data(seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+    return jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+
+
+def test_moe_block_pattern_and_specs():
+    """moe_every=2 puts MoE in odd blocks only; expert weights get the
+    model-axis (EP) spec, router and dense blocks stay as before."""
+    model = _lm()
+    tokens, _ = _lm_data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert "mlp" in params["block0"] and "moe" not in params["block0"]
+    assert "moe" in params["block1"] and "mlp" not in params["block1"]
+    assert params["block1"]["moe"]["wi"].shape == (E, 32, 128)
+    specs = lm_tp_param_specs(params)
+    moe_specs = specs["block1"]["moe"]
+    for leaf in ("wi", "wo", "bi", "bo"):
+        assert moe_specs[leaf] == P("model"), (leaf, moe_specs[leaf])
+    assert moe_specs["router"]["kernel"] == P()
+    # Megatron rules untouched in the dense block
+    assert specs["block0"]["mlp"]["fc1"]["kernel"] == P(None, "model")
+
+
+def test_moe_ep_step_matches_single_device():
+    """DP(2) x EP(4): one GSPMD train step on the 8-device mesh == the
+    single-device step (loss AND updated params), with the aux loss in the
+    objective on both sides."""
+    model = _lm()
+    tokens, labels = _lm_data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+
+    def ref_loss(p):
+        logits, inter = model.apply({"params": p}, tokens, mutable="intermediates")
+        loss = cross_entropy_loss(logits.reshape(-1, VOCAB), labels.reshape(-1))
+        for leaf in jax.tree.leaves(inter):
+            loss = loss + leaf
+        return loss
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    params_ref, _ = opt.update(grads_ref, opt.init(params), params, 0.05)
+
+    mesh = make_mesh(model_parallelism=4)
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, tp_state_shardings(state, mesh))
+    step = build_tp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, donate=False
+    )(state)
+    state2, loss_ep = step(state, tokens, labels)
+
+    np.testing.assert_allclose(float(loss_ep), float(loss_ref), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+    # experts physically sharded: 4 experts / 4-way model axis = 1 per device
+    wi = state2.params["block1"]["moe"]["wi"]
+    assert wi.sharding.spec[0] == "model"
+    assert wi.addressable_shards[0].data.shape[0] == 1
+
+
+def test_moe_aux_loss_in_objective():
+    """The compiled step's loss includes the sown aux term: it must equal
+    CE + aux, not CE alone (guards the mutable-collection plumbing in
+    tp_steps.loss_fn)."""
+    model = _lm()
+    tokens, labels = _lm_data(seed=4)
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
+    opt = SGD(lr=0.1)
+    logits, inter = model.apply({"params": params}, tokens, mutable="intermediates")
+    ce = float(cross_entropy_loss(logits.reshape(-1, VOCAB), labels.reshape(-1)))
+    aux = sum(float(leaf) for leaf in jax.tree.leaves(inter))
+    assert aux > 0
+
+    mesh = make_mesh(model_parallelism=4)
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, tp_state_shardings(state, mesh))
+    step = build_tp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, donate=False
+    )(state)
+    _, loss = step(state, tokens, labels)
+    np.testing.assert_allclose(float(loss), ce + aux, atol=1e-5)
+    assert abs(float(loss) - ce) > 1e-4  # aux is genuinely nonzero in there
