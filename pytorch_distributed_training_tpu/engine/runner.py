@@ -225,17 +225,6 @@ class Runner:
                 f"divisible by training.tensor_parallelism ({self.tensor_par}) "
                 "for an even expert split"
             )
-        if self.is_moe:
-            moe_every = int(model_cfg.get("moe_every", 2))
-            moe_depth = int(model_cfg.get("depth", 4))
-            if not 1 <= moe_every <= moe_depth:
-                # moe_every 0 would div-by-zero at init; > depth silently
-                # trains a fully dense model while every MoE restriction
-                # still applies — both are config errors, say so
-                raise ValueError(
-                    f"model.moe_every ({moe_every}) must be in [1, depth="
-                    f"{moe_depth}] (moe_every > depth would make no block MoE)"
-                )
         if self.microbatches < max(self.pipe_par, 1):
             raise ValueError(
                 f"training.microbatches ({self.microbatches}) must be >= "
@@ -309,6 +298,18 @@ class Runner:
                 dtype=self.compute_dtype,
                 **model_cfg,
             )
+            if self.is_moe and not (
+                1 <= self.model.moe_every <= self.model.depth
+            ):
+                # read from the CONSTRUCTED model, not re-hardcoded class
+                # defaults (r2 review): moe_every 0 would div-by-zero at
+                # init; > depth silently trains a fully dense model while
+                # every MoE restriction still applies
+                raise ValueError(
+                    f"model.moe_every ({self.model.moe_every}) must be in "
+                    f"[1, depth={self.model.depth}] (moe_every > depth "
+                    "would make no block MoE)"
+                )
         else:
             # reference behavior: only ``model.name`` is read for the image
             # zoo — extra keys stay ignored (forwarding them would crash

@@ -19,18 +19,66 @@ equivalent in tests/test_sequence_parallel.py.
 from __future__ import annotations
 
 import math
+import os
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from ..parallel.sequence import ring_attention, ulysses_attention
+from ..utils.vma import varying_axes_of
 
 __all__ = ["dot_product_attention", "MultiHeadAttention"]
 
+# VMEM budget for the flash kernels' resident K/V rows (f32): each kernel
+# instance holds 2 full [S, D] f32 operands plus tiles/accumulators; stay
+# well under the ~16MB scoped VMEM.
+_FLASH_VMEM_BYTES = 8 * 1024 * 1024
 
-def dot_product_attention(q, k, v, causal: bool = False, sm_scale: Optional[float] = None):
-    """Plain full attention: q,k,v ``[B, S, H, D]`` -> ``[B, S, H, D]``."""
+
+def _use_flash(q) -> bool:
+    """Trace-time flash-kernel eligibility for the local-attention path.
+
+    The Pallas path runs when (a) on real TPU, (b) INSIDE shard_map
+    (varying mesh axes present) — under plain GSPMD jit a pallas_call has
+    no SPMD partitioning rule, so the sharded TP/ZeRO/MoE paths keep the
+    einsum attention XLA can partition, while the shard_map LM paths
+    (engine/sp_steps — also the plain-DP default) get the kernel —
+    (c) the sequence divides the 128 blocks, and (d) the kernel's resident
+    K/V rows fit the VMEM budget.  ``PDT_DISABLE_PALLAS=1`` forces XLA
+    (same escape hatch as ops/losses.py).
+    """
+    if jax.default_backend() != "tpu" or os.environ.get("PDT_DISABLE_PALLAS"):
+        return False
+    if not varying_axes_of(q):
+        return False
+    b, s_len, h, d = q.shape
+    if s_len < 128 or s_len % 128:
+        return False
+    return 2 * s_len * d * 4 <= _FLASH_VMEM_BYTES
+
+
+def dot_product_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+):
+    """Full attention on the local shard: ``[B, S, H, D] -> [B, S, H, D]``.
+
+    ``impl``: ``None`` auto-selects the Pallas flash kernel
+    (:mod:`.flash_attention`) when eligible (see :func:`_use_flash`),
+    ``"flash"``/``"xla"`` force a path.
+    """
+    if impl not in (None, "flash", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    if impl == "flash" or (impl is None and _use_flash(q)):
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum(

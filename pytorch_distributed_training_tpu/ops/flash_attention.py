@@ -1,0 +1,339 @@
+"""Pallas TPU kernel: flash attention (forward + backward, causal-aware).
+
+The transformer family's hot op.  The naive path (ops/attention.py)
+materializes the full ``[B, H, S, S]`` score matrix in f32 — at S=2048
+that is 16MB per (batch, head) of HBM traffic each way, and HBM bandwidth
+is the TPU's usual bottleneck (PERF.md).  This kernel computes attention
+with the online-softmax recurrence: scores live only as one
+``[block_q, block_k]`` VMEM tile at a time, each Q/K/V element is read
+from HBM once, and nothing quadratic is ever written back.
+
+Shape contract (chosen to match ``dot_product_attention``):
+``q, k, v: [BH, S, D] -> out [BH, S, D]`` with heads pre-folded into the
+leading dim.  Compute is f32 regardless of input dtype (bf16 in, f32
+accumulate, input-dtype out) — same convention as ops/fused_ce.py.
+
+Kernel structure: grid ``(BH, S/block_q)``; each instance holds its Q tile
+plus the FULL K/V rows for that (batch, head) in VMEM (S·D f32 ≤ ~2MB for
+S=4096, D=128 — the dispatch gate in ops/attention.py falls back to XLA
+when the estimate would overflow VMEM) and runs a ``fori_loop`` over K
+blocks carrying ``(m, l, acc)`` in registers.  Causal masking also BOUNDS
+the loop — K blocks entirely above the diagonal are never visited, so the
+causal forward does ~half the FLOPs, not masked-full work.
+
+Backward is the standard flash recomputation split into two kernels wired
+through ``jax.custom_vjp``: a dQ kernel (grid over Q tiles, loop over K)
+and a dK/dV kernel (grid over K tiles, loop over Q, starting at the
+diagonal when causal), both recomputing ``p = exp(s - lse)`` from the
+forward's saved per-row logsumexp; ``delta = rowsum(dO * O)`` is one cheap
+XLA elementwise pass outside the kernels.
+
+Masked scores use a large-negative finite constant (not ``-inf``): every
+causal row has at least one valid column, so ``exp(-1e30 - m)`` underflows
+to exactly 0 and no NaN can form — the classic ``-inf - -inf`` pitfall.
+
+The kernels run on real TPU or, for the 8-virtual-device CPU test mesh, in
+Pallas interpreter mode (``interpret=True``), mirroring ops/fused_ce.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+__all__ = ["flash_attention"]
+
+_NEG = -1e30  # finite mask value; see module docstring
+_BLOCK_Q = 128
+_BLOCK_K = 128
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-mesh-axes type —
+    the ONLY vma handling these kernels need: in-kernel constants stay
+    unmarked (the Pallas interpreter's state discharge does not propagate
+    vma through in-kernel ``pl.ds`` reads either way, which is why the
+    shard_map interpreter test runs with ``check_vma=False``; Mosaic
+    lowering on real TPU never discharges and is unaffected)."""
+    try:
+        vma = jax.typeof(like).vma
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k):
+    i = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    nk = s_len // block_k
+    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+
+    if causal:
+        # K blocks strictly above this Q tile's last row never contribute
+        nj = jnp.minimum(nk, ((i + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nj = nk
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    d = q_ref.shape[-1]
+    carry0 = (
+        jnp.full((block_q,), _NEG, jnp.float32),
+        jnp.zeros((block_q,), jnp.float32),
+        jnp.zeros((block_q, d), jnp.float32),
+    )
+    m, l, acc = jax.lax.fori_loop(0, nj, body, carry0)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = m + jnp.log(l)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, scale, causal, block_q, block_k,
+):
+    i = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    nk = s_len // block_k
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    nj = (
+        jnp.minimum(nk, ((i + 1) * block_q + block_k - 1) // block_k)
+        if causal
+        else nk
+    )
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, nj, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, scale, causal, block_q, block_k,
+):
+    j = pl.program_id(1)
+    s_len = q_ref.shape[1]
+    nq = s_len // block_q
+    kb = k_ref[0].astype(jnp.float32)  # [bk, d]
+    vb = v_ref[0].astype(jnp.float32)
+    # Q tiles strictly before this K tile's first row never attend to it
+    i0 = (j * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
+        s = scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    d = q_ref.shape[-1]
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(i0, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(s_len: int):
+    bq = min(_BLOCK_Q, s_len)
+    bk = min(_BLOCK_K, s_len)
+    if s_len % bq or s_len % bk:
+        raise ValueError(
+            f"flash_attention requires seq {s_len} divisible by block sizes "
+            f"({bq}, {bk}); use the XLA path for ragged lengths"
+        )
+    return bq, bk
+
+
+@functools.lru_cache(maxsize=None)
+def _make(causal: bool, interpret: bool, scale: float):
+    """Build the custom-VJP'd flash attention for a static (causal, mode,
+    scale) triple — scale is a trace-time constant folded into the kernels,
+    and the cache sees only a handful of distinct head dims."""
+
+    def _forward(q, k, v):
+        bh, s_len, d = q.shape
+        bq, bk = _blocks(s_len)
+        kern = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        )
+        row = lambda b, i: (b, i, 0)  # noqa: E731
+        full = lambda b, i: (b, 0, 0)  # noqa: E731
+        return pl.pallas_call(
+            kern,
+            grid=(bh, s_len // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), row),
+                pl.BlockSpec((1, s_len, d), full),
+                pl.BlockSpec((1, s_len, d), full),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, d), row),
+                # lse rides as [bh, s, 1]: Mosaic requires the block's last
+                # two dims be (8k, 128m) or array-equal — a [bh, s] layout
+                # with (1, bq) blocks violates that
+                pl.BlockSpec((1, bq, 1), row),
+            ],
+            out_shape=[
+                _out_struct(q.shape, q.dtype, q),
+                _out_struct((bh, s_len, 1), jnp.float32, q),
+            ],
+            interpret=interpret,
+        )(q, k, v)
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _forward(q, k, v)[0]
+
+    def attn_fwd(q, k, v):
+        o, lse = _forward(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def attn_bwd(res, g):
+        q, k, v, o, lse = res
+        bh, s_len, d = q.shape
+        bq, bk = _blocks(s_len)
+        delta = jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+        )  # [bh, s, 1] (3-D for the same Mosaic block rule as lse)
+        row = lambda b, i: (b, i, 0)  # noqa: E731
+        full = lambda b, i: (b, 0, 0)  # noqa: E731
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+            ),
+            grid=(bh, s_len // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), row),
+                pl.BlockSpec((1, s_len, d), full),
+                pl.BlockSpec((1, s_len, d), full),
+                pl.BlockSpec((1, bq, d), row),
+                pl.BlockSpec((1, bq, 1), row),
+                pl.BlockSpec((1, bq, 1), row),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), row),
+            out_shape=_out_struct(q.shape, q.dtype, q),
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+            ),
+            grid=(bh, s_len // bk),
+            in_specs=[
+                pl.BlockSpec((1, s_len, d), full),
+                pl.BlockSpec((1, bk, d), row),
+                pl.BlockSpec((1, bk, d), row),
+                pl.BlockSpec((1, s_len, d), full),
+                pl.BlockSpec((1, s_len, 1), full),
+                pl.BlockSpec((1, s_len, 1), full),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), row),
+                pl.BlockSpec((1, bk, d), row),
+            ],
+            out_shape=[
+                _out_struct(k.shape, k.dtype, k),
+                _out_struct(v.shape, v.dtype, v),
+            ],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        return dq, dk, dv
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    *,
+    interpret: bool = False,
+):
+    """Flash attention: ``q, k, v [B, S, H, D] -> [B, S, H, D]``.
+
+    Numerically equivalent to :func:`..ops.attention.dot_product_attention`
+    (tested to ~1e-5 in tests/test_flash_attention.py); O(S) memory instead
+    of O(S^2).  Heads are folded into the batch dim for the kernels.
+
+    Args:
+      interpret: run the kernels in Pallas interpreter mode (for CPU test
+        meshes); on TPU leave False.
+    """
+    b, s_len, h, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s_len, d)
+
+    out = _make(bool(causal), bool(interpret), float(scale))(
+        fold(q), fold(k), fold(v)
+    )
+    return jnp.swapaxes(out.reshape(b, h, s_len, d), 1, 2)

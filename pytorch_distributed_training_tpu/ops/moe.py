@@ -29,8 +29,10 @@ because they are the part reviewers argue about):
   - router logits + softmax in float32 regardless of compute dtype
     (router numerics drive a discrete choice; bf16 ties flip experts),
   - top-k gates renormalized to sum to 1 over the chosen k (Mixtral
-    convention; with k=1 this is Switch's single gate = its probability),
-  - slots fill token-major within each group with slot-0 (primary expert)
+    convention) — EXCEPT k=1, which keeps the raw top-1 probability as the
+    gate (Switch convention; renormalizing a single gate to 1.0 would zero
+    the router's task-loss gradient),
+  - slots fill SLOT-major within each group with slot-0 (primary expert)
     priority; tokens over capacity are DROPPED for that expert — their
     combine weight is 0, so with the transformer's residual connection
     they pass through unchanged (GShard behavior),
@@ -84,7 +86,12 @@ class MoEMLP(nn.Module):
         )  # [g, s, E]
         probs = jax.nn.softmax(logits, axis=-1)
         gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [g, s, k]
-        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+        if k > 1:
+            gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+        # k == 1 keeps the RAW probability as the gate (Switch): renormalizing
+        # would collapse it to exactly 1.0 and cut the router off from the
+        # task-loss gradient entirely (r2 code-review finding — the router
+        # would then train on the aux loss alone)
 
         cap = max(1, int(math.ceil(self.capacity_factor * k * s / E)))
         # slot-major fill within each group: every token's primary (slot-0)

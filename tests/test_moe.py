@@ -63,17 +63,41 @@ def test_moe_dense_equivalence():
 
 
 def test_moe_top1_routing_exact():
-    """k=1 + ample capacity: every token gets exactly its argmax expert."""
+    """k=1 + ample capacity: every token gets its argmax expert weighted by
+    the RAW top-1 probability (Switch gate — NOT renormalized to 1, which
+    would sever the router from the task-loss gradient)."""
     x = _x(1)
     moe = MoEMLP(num_experts=E, top_k=1, capacity_factor=float(E), hidden=H, out=D)
     params = moe.init(jax.random.PRNGKey(1), x)["params"]
     y = moe.apply({"params": params}, x, mutable="intermediates")[0].reshape(T, D)
     xf = x.reshape(T, D)
-    sel = np.asarray(jnp.argmax(_router_probs(params, xf), -1))
+    probs = np.asarray(_router_probs(params, xf))
+    sel = probs.argmax(-1)
     for t in range(T):
         np.testing.assert_allclose(
-            np.asarray(y[t]), np.asarray(_expert(params, sel[t], xf[t])), atol=1e-5
+            np.asarray(y[t]),
+            probs[t, sel[t]] * np.asarray(_expert(params, sel[t], xf[t])),
+            atol=1e-5,
         )
+
+
+def test_moe_top1_router_gets_task_gradient():
+    """The k=1 gate must carry task-loss gradient to the router (r2 review:
+    a renormalized single gate is the constant 1.0 and d(loss)/d(router)
+    vanishes, leaving the router trained by the aux loss alone)."""
+    x = _x(8)
+    moe = MoEMLP(
+        num_experts=E, top_k=1, capacity_factor=float(E), hidden=H, out=D,
+        aux_weight=0.0,
+    )
+    params = moe.init(jax.random.PRNGKey(4), x)["params"]
+
+    def task_loss(p):
+        y = moe.apply({"params": p}, x, mutable="intermediates")[0]
+        return jnp.sum(y**2)
+
+    g = jax.grad(task_loss)(params)
+    assert float(jnp.max(jnp.abs(g["router"]["kernel"]))) > 1e-6
 
 
 def test_moe_capacity_drop_passthrough():
