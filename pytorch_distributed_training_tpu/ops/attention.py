@@ -19,7 +19,6 @@ equivalent in tests/test_sequence_parallel.py.
 from __future__ import annotations
 
 import math
-import os
 from typing import Optional
 
 import jax
@@ -30,12 +29,6 @@ from ..parallel.sequence import ring_attention, ulysses_attention
 from ..utils.vma import varying_axes_of
 
 __all__ = ["dot_product_attention", "MultiHeadAttention"]
-
-# VMEM budget for the flash kernels' resident K/V rows (f32): each kernel
-# instance holds 2 full [S, D] f32 operands plus tiles/accumulators; stay
-# well under the ~16MB scoped VMEM.
-_FLASH_VMEM_BYTES = 8 * 1024 * 1024
-
 
 def _use_flash(q) -> bool:
     """Trace-time flash-kernel eligibility for the local-attention path.
@@ -49,14 +42,14 @@ def _use_flash(q) -> bool:
     K/V rows fit the VMEM budget.  ``PDT_DISABLE_PALLAS=1`` forces XLA
     (same escape hatch as ops/losses.py).
     """
-    if jax.default_backend() != "tpu" or os.environ.get("PDT_DISABLE_PALLAS"):
+    from .flash_attention import flash_enabled, flash_shapes_ok
+
+    if not flash_enabled():
         return False
     if not varying_axes_of(q):
         return False
     b, s_len, h, d = q.shape
-    if s_len < 128 or s_len % 128:
-        return False
-    return 2 * s_len * d * 4 <= _FLASH_VMEM_BYTES
+    return flash_shapes_ok(s_len, d)
 
 
 def dot_product_attention(
@@ -66,19 +59,23 @@ def dot_product_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     impl: Optional[str] = None,
+    interpret: bool = False,
 ):
     """Full attention on the local shard: ``[B, S, H, D] -> [B, S, H, D]``.
 
     ``impl``: ``None`` auto-selects the Pallas flash kernel
     (:mod:`.flash_attention`) when eligible (see :func:`_use_flash`),
-    ``"flash"``/``"xla"`` force a path.
+    ``"flash"``/``"xla"`` force a path.  ``interpret`` runs a forced
+    flash path in Pallas interpreter mode (CPU test meshes).
     """
     if impl not in (None, "flash", "xla"):
         raise ValueError(f"unknown attention impl {impl!r}")
     if impl == "flash" or (impl is None and _use_flash(q)):
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret
+        )
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum(

@@ -46,11 +46,31 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_lse", "flash_shapes_ok", "flash_enabled"]
 
 _NEG = -1e30  # finite mask value; see module docstring
 _BLOCK_Q = 128
 _BLOCK_K = 128
+# VMEM budget for the kernels' resident K/V rows (f32): each instance holds
+# 2 full [S, D] f32 operands plus tiles/accumulators; stay well under the
+# ~16MB scoped VMEM.  Single source of truth for every dispatch gate
+# (ops/attention.py local path AND parallel/sequence.py ring inner).
+_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def flash_shapes_ok(s_len: int, d: int) -> bool:
+    """Shape/VMEM eligibility shared by all flash dispatch gates."""
+    return s_len >= 128 and s_len % 128 == 0 and 2 * s_len * d * 4 <= _VMEM_BYTES
+
+
+def flash_enabled() -> bool:
+    """Backend + escape-hatch half of the dispatch gates (shared by
+    ops.attention._use_flash and parallel.sequence._ring_flash_ok)."""
+    import os
+
+    return jax.default_backend() == "tpu" and not os.environ.get(
+        "PDT_DISABLE_PALLAS"
+    )
 
 
 def _out_struct(shape, dtype, like):
@@ -210,10 +230,13 @@ def _blocks(s_len: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _make(causal: bool, interpret: bool, scale: float):
+def _make(causal: bool, interpret: bool, scale: float, out_f32: bool = False):
     """Build the custom-VJP'd flash attention for a static (causal, mode,
-    scale) triple — scale is a trace-time constant folded into the kernels,
-    and the cache sees only a handful of distinct head dims."""
+    scale, out-dtype) tuple — scale is a trace-time constant folded into the
+    kernels, and the cache sees only a handful of distinct head dims.
+    ``out_f32`` keeps the block output o in f32 regardless of input dtype
+    (the ring combine accumulates across blocks and must not round each
+    partial to bf16)."""
 
     def _forward(q, k, v):
         bh, s_len, d = q.shape
@@ -239,7 +262,7 @@ def _make(causal: bool, interpret: bool, scale: float):
                 pl.BlockSpec((1, bq, 1), row),
             ],
             out_shape=[
-                _out_struct(q.shape, q.dtype, q),
+                _out_struct(q.shape, jnp.float32 if out_f32 else q.dtype, q),
                 _out_struct((bh, s_len, 1), jnp.float32, q),
             ],
             interpret=interpret,
@@ -247,19 +270,25 @@ def _make(causal: bool, interpret: bool, scale: float):
 
     @jax.custom_vjp
     def attn(q, k, v):
-        return _forward(q, k, v)[0]
+        return _forward(q, k, v)
 
     def attn_fwd(q, k, v):
         o, lse = _forward(q, k, v)
-        return o, (q, k, v, o, lse)
+        return (o, lse), (q, k, v, o, lse)
 
-    def attn_bwd(res, g):
+    def attn_bwd(res, cts):
         q, k, v, o, lse = res
+        g, g_lse = cts  # cotangents for (o, lse)
         bh, s_len, d = q.shape
         bq, bk = _blocks(s_len)
+        # d(lse)/d(s) = p, so an lse cotangent folds into the kernels as a
+        # shift of delta: ds = p * (dp - (delta - g_lse)) — this is what
+        # makes the ring-attention combine (which consumes lse) exactly
+        # differentiable through the same two backward kernels
         delta = jnp.sum(
             g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
         )  # [bh, s, 1] (3-D for the same Mosaic block rule as lse)
+        delta = delta - g_lse.astype(jnp.float32)
         row = lambda b, i: (b, i, 0)  # noqa: E731
         full = lambda b, i: (b, 0, 0)  # noqa: E731
         dq = pl.pallas_call(
@@ -327,13 +356,38 @@ def flash_attention(
       interpret: run the kernels in Pallas interpreter mode (for CPU test
         meshes); on TPU leave False.
     """
+    return flash_attention_lse(
+        q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret,
+        out_f32=False,  # hot path: write o in input dtype (bf16), not f32
+    )[0]
+
+
+def flash_attention_lse(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    *,
+    interpret: bool = False,
+    out_f32: bool = True,
+):
+    """Like :func:`flash_attention`, additionally returning the per-row
+    logsumexp ``[B, S, H]`` (f32) — the quantity blockwise/ring attention
+    needs to combine partial attention results across K/V blocks.  The
+    custom VJP is exact for cotangents on BOTH outputs (an lse cotangent
+    shifts the backward's delta; see ``attn_bwd``).  ``out_f32`` (default)
+    returns o in f32 so a cross-block combine does not round each partial
+    to the input dtype."""
     b, s_len, h, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
     def fold(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s_len, d)
 
-    out = _make(bool(causal), bool(interpret), float(scale))(
+    out, lse = _make(bool(causal), bool(interpret), float(scale), bool(out_f32))(
         fold(q), fold(k), fold(v)
     )
-    return jnp.swapaxes(out.reshape(b, h, s_len, d), 1, 2)
+    out = jnp.swapaxes(out.reshape(b, h, s_len, d), 1, 2)
+    lse = jnp.transpose(lse.reshape(b, h, s_len), (0, 2, 1))  # [B, S, H]
+    return out, lse

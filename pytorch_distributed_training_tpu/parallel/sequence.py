@@ -67,6 +67,92 @@ def _block_attn(q, k, v, scale, q_off, k_off, causal, m, l, o):
     return m_new, l_new, o_new
 
 
+def _ring_flash_ok(q) -> bool:
+    """Flash-kernel eligibility for the ring inner blocks (same gate as
+    ``ops.attention._use_flash`` minus the shard_map check — ring attention
+    is by contract inside shard_map; the shape/VMEM rule is the shared
+    :func:`..ops.flash_attention.flash_shapes_ok`)."""
+    from ..ops.flash_attention import flash_enabled, flash_shapes_ok
+
+    if not flash_enabled():
+        return False
+    b, s_local, h, d = q.shape
+    return flash_shapes_ok(s_local, d)
+
+
+def _ring_attention_flash(
+    q, k, v, axis_name, causal, scale, interpret=False
+):
+    """Ring attention with the Pallas flash kernel as the per-step block
+    attention — the Ring Attention paper's actual construction (blockwise
+    flash inner, ppermute outer).  Each ring step is one of three static
+    cases by global block position: strictly-past K/V blocks get full
+    (unmasked) flash, the diagonal block causal flash, future blocks a
+    masked no-op; partial results combine with the logsumexp rule
+    ``o = w_acc*o_acc + w_b*o_b, w = exp(lse - logaddexp(...))``, which is
+    exactly differentiable because :func:`..ops.flash_attention.
+    flash_attention_lse`'s VJP handles lse cotangents."""
+    from ..ops.flash_attention import flash_attention_lse
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    perm = [(j, (j - 1) % n) for j in range(n)]
+    axes = varying_axes_of(q, (axis_name,))
+
+    def full_fn(kc, vc):
+        return flash_attention_lse(
+            q, kc, vc, causal=False, sm_scale=scale, interpret=interpret
+        )
+
+    def causal_fn(kc, vc):
+        return flash_attention_lse(
+            q, kc, vc, causal=True, sm_scale=scale, interpret=interpret
+        )
+
+    def masked_fn(kc, vc):
+        del kc, vc
+        # f32 like the flash branches' out_f32 outputs (switch branch types
+        # must match; the combine accumulates in f32 across ring steps)
+        return mark_varying(
+            (
+                jnp.zeros(q.shape, jnp.float32),
+                jnp.full((b, s_local, h), _NEG_INF, jnp.float32),
+            ),
+            axes,
+        )
+
+    def step(i, carry):
+        o_acc, lse_acc, k_cur, v_cur = carry
+        src = (idx + i) % n
+        if causal:
+            branch = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+            o_b, lse_b = jax.lax.switch(
+                branch, [full_fn, causal_fn, masked_fn], k_cur, v_cur
+            )
+        else:
+            o_b, lse_b = full_fn(k_cur, v_cur)
+        # combine: step 0 is always the (finite-everywhere) diagonal block,
+        # so lse_acc is finite from then on and no -inf - -inf NaN can form
+        lse_new = jnp.logaddexp(lse_acc, lse_b)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_b = jnp.exp(lse_b - lse_new)[..., None]
+        o_new = w_acc * o_acc + w_b * o_b  # all f32 (out_f32 block outputs)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return o_new, lse_new, k_nxt, v_nxt
+
+    o0, lse0 = mark_varying(
+        (
+            jnp.zeros((b, s_local, h, d), jnp.float32),
+            jnp.full((b, s_local, h), _NEG_INF, jnp.float32),
+        ),
+        axes,
+    )
+    o, _, _, _ = jax.lax.fori_loop(0, n, step, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -74,6 +160,8 @@ def ring_attention(
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded across a device ring.
 
@@ -84,13 +172,22 @@ def ring_attention(
     Args:
       q, k, v: local shards ``[batch, seq_local, heads, head_dim]``.
       causal: apply a causal mask over *global* positions.
+      impl: ``None`` auto-selects the Pallas flash inner kernel when
+        eligible (:func:`_ring_flash_ok`); ``"flash"``/``"xla"`` force.
+      interpret: Pallas interpreter mode for the flash inner (CPU tests).
     Returns:
       ``[batch, seq_local, heads, head_dim]`` in ``q.dtype``.
     """
-    n = jax.lax.psum(1, axis_name)  # static axis size
-    idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if impl not in (None, "flash", "xla"):
+        raise ValueError(f"unknown ring impl {impl!r}")
+    if impl == "flash" or (impl is None and _ring_flash_ok(q)):
+        return _ring_attention_flash(
+            q, k, v, axis_name, causal, scale, interpret=interpret
+        )
+    n = jax.lax.psum(1, axis_name)  # static axis size
+    idx = jax.lax.axis_index(axis_name)
 
     m0 = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s_local), jnp.float32)
@@ -127,6 +224,8 @@ def ulysses_attention(
     axis_name: str = SEQUENCE_AXIS,
     causal: bool = False,
     sm_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses construction).
 
@@ -147,13 +246,16 @@ def ulysses_attention(
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", qg.astype(jnp.float32), kg.astype(jnp.float32)
-    ) * scale
-    if causal:
-        s_full = s.shape[-1]
-        pos = jnp.arange(s_full)
-        s = jnp.where((pos[:, None] >= pos[None, :])[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32))
-    return gather_heads(out.astype(q.dtype))
+    # after the reshard this is ordinary full attention over the local head
+    # group — route through the shared local-attention dispatch so the
+    # Pallas flash kernel applies on TPU (function-level import: attention.py
+    # imports this module at load time).  ``impl``/``interpret`` mirror
+    # ring_attention's (interpret = flash in Pallas interpreter mode for the
+    # CPU test mesh).
+    from ..ops.attention import dot_product_attention
+
+    out = dot_product_attention(
+        qg, kg, vg, causal=causal, sm_scale=scale, impl=impl,
+        interpret=interpret,
+    )
+    return gather_heads(out)

@@ -123,3 +123,119 @@ def test_ring_attention_grad_matches_full():
     g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_ring, g_full):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_inner_matches_full(causal):
+    """Ring attention with the Pallas flash inner kernel (impl="flash",
+    interpreter mode) == single-device full attention — forward AND grads.
+    s=1024 over 8 devices gives one 128-row flash block per ring step.
+
+    check_vma=False: the Pallas INTERPRETER's state discharge cannot
+    propagate varying-axes through in-kernel pl.ds reads (see
+    tests/test_flash_attention.py); the production path compiles via Mosaic
+    on real TPU where no discharge happens.
+    """
+    q, k, v = _make_qkv(s=1024, d=32, seed=7)
+    mesh = _seq_mesh()
+    spec = P(None, AXIS, None, None)
+
+    ring = jax.jit(
+        jax.shard_map(
+            lambda a, b_, c: ring_attention(
+                a, b_, c, AXIS, causal=causal, impl="flash", interpret=True
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    # differentiate OUTSIDE the shard_map: ring's forward graph has no psum,
+    # so the unchecked-mode collective-transpose caveat never applies and
+    # the q/k/v cotangents ride the ppermute transposes + flash VJP only.
+    # ALL THREE grads are compared — dk/dv exercise the lse-cotangent
+    # folding and the masked-branch transpose, the riskiest new paths.
+    loss_ring, grads_ring = jax.value_and_grad(
+        lambda a, b_, c: jnp.sum(jnp.sin(ring(a, b_, c))), argnums=(0, 1, 2)
+    )(q, k, v)
+
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda a, b_, c: jnp.sum(jnp.sin(full_attention(a, b_, c, causal))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    np.testing.assert_allclose(float(loss_ring), float(loss_ref), rtol=1e-5)
+    for gr, gf, name in zip(grads_ring, grads_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gf), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_multiblock_local(causal):
+    """s_local=256 = 2 flash blocks per ring step: the inner kernel's own
+    block loop composes with the ring combine."""
+    q, k, v = _make_qkv(s=2048, d=16, seed=8)
+    mesh = _seq_mesh()
+    spec = P(None, AXIS, None, None)
+    f = jax.jit(
+        jax.shard_map(
+            lambda a, b_, c: ring_attention(
+                a, b_, c, AXIS, causal=causal, impl="flash", interpret=True
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    got = f(q, k, v)
+    ref = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-5)
+
+
+def test_ring_impl_validation():
+    q, k, v = _make_qkv()
+    mesh = _seq_mesh()
+    spec = P(None, AXIS, None, None)
+    with pytest.raises(ValueError, match="impl"):
+        jax.shard_map(
+            lambda a, b_, c: ring_attention(a, b_, c, AXIS, impl="pallas"),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_inner_matches_full(causal):
+    """Ulysses with the flash local attention (impl="flash", interpreter
+    mode): the flash-under-shard_map-after-all-to-all composition must
+    equal full attention — forward and all three grads (r2 review: this
+    composition previously only executed on real hardware)."""
+    q, k, v = _make_qkv(s=1024, h=8, d=16, seed=9)
+    mesh = _seq_mesh()
+    spec = P(None, AXIS, None, None)
+    uly = jax.jit(
+        jax.shard_map(
+            lambda a, b_, c: ulysses_attention(
+                a, b_, c, AXIS, causal=causal, impl="flash", interpret=True
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    loss_u, grads_u = jax.value_and_grad(
+        lambda a, b_, c: jnp.sum(jnp.sin(uly(a, b_, c))), argnums=(0, 1, 2)
+    )(q, k, v)
+    loss_ref, grads_ref = jax.value_and_grad(
+        lambda a, b_, c: jnp.sum(jnp.sin(full_attention(a, b_, c, causal))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    np.testing.assert_allclose(float(loss_u), float(loss_ref), rtol=1e-5)
+    for gu, gf, name in zip(grads_u, grads_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gu), np.asarray(gf), atol=5e-5, err_msg=f"d{name}"
+        )
