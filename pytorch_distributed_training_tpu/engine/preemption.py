@@ -1,0 +1,73 @@
+"""Preemption-safe training: checkpoint-and-exit on SIGTERM.
+
+The reference has no failure handling beyond a log-and-delete crash hook
+(SURVEY.md §5.3 — a worker loss restarts the 450k-iteration run from
+zero).  TPU pods make this concrete: preemptible/spot capacity delivers
+SIGTERM with a grace window before eviction.  This guard turns that signal
+into a clean save-and-exit: the training loop polls ``triggered`` once per
+iteration (a Python bool check — nothing enters the compiled step) and,
+when set, writes a checkpoint at the CURRENT iteration and stops; the next
+launch resumes from it via the normal ``checkpoint.resume`` path.
+
+Enabled automatically whenever checkpointing is configured (set
+``training.checkpoint.preemption: False`` to opt out).  Signal handlers
+are process-wide and only installable from the main thread; elsewhere the
+guard degrades to an inert flag (documented, logged).
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional, Sequence
+
+__all__ = ["PreemptionGuard"]
+
+
+class PreemptionGuard:
+    """Latches termination signals into a pollable flag.
+
+    Use as a context manager around the training loop; previous handlers
+    are restored on exit so nested/sequential Runners (tests) don't leak
+    process state.
+    """
+
+    def __init__(
+        self,
+        signals: Sequence[int] = (signal.SIGTERM,),
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.signals = tuple(signals)
+        self.logger = logger
+        self.triggered = False
+        self._prev: dict = {}
+        self._installed = False
+
+    def _handler(self, signum, frame):
+        # async-signal-safe: ONLY set the flag.  Logging here can self-
+        # deadlock — the runner's QueueHandler takes a non-reentrant lock,
+        # and the handler may interrupt the main thread mid-logging-call
+        # (r2 code-review finding); the poll site in Runner._train_loop
+        # logs the event instead.
+        del signum, frame
+        self.triggered = True
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            if self.logger:
+                self.logger.warning(
+                    "PreemptionGuard: not on the main thread, signal "
+                    "handlers unavailable — preemption checkpointing disabled"
+                )
+            return self
+        for sig in self.signals:
+            self._prev[sig] = signal.signal(sig, self._handler)
+        self._installed = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._installed = False
+        return None

@@ -678,10 +678,63 @@ class Runner:
             self._put_batch,
         )
 
+        # --- preemption safety (engine/preemption.py; beyond reference) -----
+        # SIGTERM (spot/preemptible eviction notice) -> checkpoint at the
+        # current iteration and exit cleanly; the relaunch resumes from it.
+        # Active whenever checkpointing is configured, opt-out via
+        # ``training.checkpoint.preemption: False``.
+        from .preemption import PreemptionGuard
+
+        use_guard = self.checkpointer is not None and train_cfg["checkpoint"].get(
+            "preemption", True
+        )
+        self._preempt = (
+            PreemptionGuard(logger=self.logger) if use_guard else None
+        )
+        # Multi-process: checkpointer.save is a COLLECTIVE, and the signal
+        # may land on one host only (or at different loop positions), so
+        # hosts must AGREE on preemption at the same iteration or the save
+        # deadlocks with mismatched participants (r2 code-review finding).
+        # Every ``preemption_sync_interval`` iters (default 10) all hosts
+        # allgather their local flags and act only on the global OR —
+        # well within any eviction grace window.  Single process acts on
+        # the local flag immediately, no collective.
+        self._preempt_sync = int(
+            train_cfg["checkpoint"].get("preemption_sync_interval", 10)
+            if self.checkpointer
+            else 10
+        )
+        if self._preempt_sync < 1:
+            raise ValueError(
+                f"checkpoint.preemption_sync_interval must be >= 1, got "
+                f"{self._preempt_sync}"
+            )
+        import contextlib
+
+        with self._preempt if self._preempt else contextlib.nullcontext():
+            self._train_loop(iter_generator, train_cfg)
+        if self.profiler:
+            self.profiler.finalize()
+        if self.checkpointer:
+            self.checkpointer.wait()
+            self.checkpointer.close()
+        self.train_loader.close()
+        self.val_loader.close()
+
+    def _train_loop(self, iter_generator, train_cfg):
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
             g_img, g_label = next(iter_generator)
             self.train_iter(g_img, g_label)
+            if self._preempt and self._globally_preempted():
+                self.logger.warning(
+                    "Preemption signal received: saving checkpoint at iter "
+                    "%d and exiting",
+                    self.iter,
+                )
+                self.checkpointer.save(self.iter, self.state)
+                self.checkpointer.wait()
+                return
             if self.profiler:
                 self.profiler.after_step(self.iter, sync=self.state)
 
@@ -708,13 +761,24 @@ class Runner:
                     # so the window can't reopen over in-flight checkpoint I/O
                     self.checkpointer.wait()
             self.iter += 1
-        if self.profiler:
-            self.profiler.finalize()
-        if self.checkpointer:
-            self.checkpointer.wait()
-            self.checkpointer.close()
-        self.train_loader.close()
-        self.val_loader.close()
+
+    def _globally_preempted(self) -> bool:
+        """Whether to act on preemption at THIS iteration, agreed across
+        processes (see the wiring comment in ``worker``).  Single process:
+        the local flag, immediately.  Multi-process: all hosts execute the
+        same allgather at the same iterations (the condition depends only
+        on the shared iteration counter), so the collective cannot
+        mismatch, and every host sees the same OR-ed verdict."""
+        if jax.process_count() == 1:
+            return self._preempt.triggered
+        if (self.iter + 1) % self._preempt_sync != 0:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray(bool(self._preempt.triggered))
+        )
+        return bool(np.any(flags))
 
     # ------------------------------------------------------------- hot loop
     def _put_batch(self, img: np.ndarray, label: np.ndarray):

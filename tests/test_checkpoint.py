@@ -108,3 +108,65 @@ def test_resume_bit_exact_vs_straight_run(tmp_path):
     b = jax.tree.map(np.asarray, resumed.state.params)
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_array_equal(x, y)
+
+
+def test_preemption_guard_restores_handlers():
+    import signal
+
+    from pytorch_distributed_training_tpu.engine.preemption import PreemptionGuard
+
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert signal.getsignal(signal.SIGTERM) is not before
+        assert not g.triggered
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_preemption_checkpoints_current_iter_and_resumes(tmp_path, monkeypatch):
+    """SIGTERM mid-run (engine/preemption.py): the loop must save a
+    checkpoint at the CURRENT iteration — not an interval boundary — exit
+    cleanly, and a relaunch must resume past it to completion.
+
+    The signal is raised from inside the third train_iter (so the guard is
+    installed and the timing is deterministic — a wall-clock timer can fire
+    during setup, before the guard exists, and kill the process)."""
+    import os
+    import signal
+
+    cfg = _cfg(tmp_path, train_iters=400)
+    # a huge interval isolates the preemption save from the periodic one
+    cfg["training"]["checkpoint"]["interval"] = 10_000
+
+    orig = Runner.train_iter
+    calls = {"n": 0}
+
+    def train_then_preempt(self, *args):
+        orig(self, *args)
+        calls["n"] += 1
+        if calls["n"] == 3:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    monkeypatch.setattr(Runner, "train_iter", train_then_preempt)
+    runner = _run(cfg)
+    monkeypatch.setattr(Runner, "train_iter", orig)
+    stopped_at = runner.iter
+    assert stopped_at == 2  # preempted during the 3rd iteration (0-indexed)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    assert ck.latest() == stopped_at
+    ck.close()
+
+    # relaunch with a few more iters: resumes from the preemption save
+    cfg2 = _cfg(tmp_path, train_iters=stopped_at + 3)
+    cfg2["training"]["checkpoint"]["interval"] = 10_000
+    runner2 = _run(cfg2)
+    assert runner2.iter == stopped_at + 3
+
+
+def test_preemption_opt_out(tmp_path):
+    """checkpoint.preemption: False keeps the reference's fail-fast
+    behavior — no guard is installed."""
+    cfg = _cfg(tmp_path, train_iters=2)
+    cfg["training"]["checkpoint"]["preemption"] = False
+    runner = _run(cfg)
+    assert runner._preempt is None
+    assert runner.iter == 2
