@@ -350,11 +350,6 @@ class Runner:
         self.grad_accum = int(train_cfg.get("grad_accumulation", 1))
         if self.grad_accum < 1:
             raise ValueError(f"grad_accumulation must be >= 1, got {self.grad_accum}")
-        if self.grad_accum > 1 and (self.tensor_par > 1 or self.zero or self.is_moe):
-            raise ValueError(
-                "grad_accumulation is not supported on the GSPMD LM path "
-                "(tensor_parallelism / zero / moe) yet"
-            )
         if self.grad_accum > 1 and self.pipe_par > 1:
             raise ValueError(
                 "grad_accumulation is redundant under pipeline_parallelism — "
@@ -581,6 +576,7 @@ class Runner:
             self.train_step = build_tp_lm_train_step(
                 self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
                 label_smoothing=self.label_smoothing, zero=self.zero,
+                grad_accum=self.grad_accum,
             )(self.state)
             self.eval_step = build_tp_lm_eval_step(
                 self.model, self.mesh, zero=self.zero
@@ -699,16 +695,16 @@ class Runner:
         # allgather their local flags and act only on the global OR —
         # well within any eviction grace window.  Single process acts on
         # the local flag immediately, no collective.
-        self._preempt_sync = int(
-            train_cfg["checkpoint"].get("preemption_sync_interval", 10)
-            if self.checkpointer
-            else 10
-        )
-        if self._preempt_sync < 1:
-            raise ValueError(
-                f"checkpoint.preemption_sync_interval must be >= 1, got "
-                f"{self._preempt_sync}"
+        self._preempt_sync = 10
+        if use_guard:
+            self._preempt_sync = int(
+                train_cfg["checkpoint"].get("preemption_sync_interval", 10)
             )
+            if self._preempt_sync < 1:
+                raise ValueError(
+                    f"checkpoint.preemption_sync_interval must be >= 1, got "
+                    f"{self._preempt_sync}"
+                )
         import contextlib
 
         with self._preempt if self._preempt else contextlib.nullcontext():

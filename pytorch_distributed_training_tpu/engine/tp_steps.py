@@ -50,6 +50,7 @@ def build_tp_lm_train_step(
     donate: bool = True,
     label_smoothing: float = 0.0,
     zero: bool = False,
+    grad_accum: int = 1,
 ):
     """Compile one DP x TP LM iteration (GSPMD-partitioned).
 
@@ -58,31 +59,74 @@ def build_tp_lm_train_step(
     :func:`..parallel.tensor.tp_state_shardings` to place the state before
     the first call; in/out shardings are pinned so XLA keeps params resident
     in their TP layout across steps.
+
+    ``grad_accum``: process the batch as N sequential micro-batches under
+    ``lax.scan`` (activation memory / N).  Equal micro sizes make the mean
+    of per-micro mean losses the exact full-batch objective; for MoE the
+    aux loss (and routing capacity) is likewise per-micro — the average of
+    per-micro aux terms, the standard accumulation semantics.
     """
+    import jax.numpy as jnp
+
+    def loss_fn(p, tokens, labels):
+        # mutable="intermediates" collects sown auxiliary objectives —
+        # today the MoE load-balancing loss (ops/moe.py sows the
+        # already-weighted value under ``moe_aux``); dense models sow
+        # nothing.  Only ``moe_aux`` entries join the objective: other
+        # sown intermediates (telemetry, debugging) must NOT leak into
+        # the loss (r2 code-review finding).  Validation stays pure CE.
+        logits, inter = model.apply(
+            {"params": p}, tokens, mutable="intermediates"
+        )
+        vocab = logits.shape[-1]
+        loss = cross_entropy_loss(
+            logits.reshape(-1, vocab), labels.reshape(-1), label_smoothing
+        )
+        for path, leaf in jax.tree_util.tree_flatten_with_path(inter)[0]:
+            if any(
+                str(getattr(key, "key", key)) == "moe_aux" for key in path
+            ):
+                loss = loss + leaf
+        return loss
 
     def step(state: TrainState, tokens, labels):
-        def loss_fn(p):
-            # mutable="intermediates" collects sown auxiliary objectives —
-            # today the MoE load-balancing loss (ops/moe.py sows the
-            # already-weighted value under ``moe_aux``); dense models sow
-            # nothing.  Only ``moe_aux`` entries join the objective: other
-            # sown intermediates (telemetry, debugging) must NOT leak into
-            # the loss (r2 code-review finding).  Validation stays pure CE.
-            logits, inter = model.apply(
-                {"params": p}, tokens, mutable="intermediates"
+        if grad_accum > 1:
+            b, seq = tokens.shape
+            if b % grad_accum != 0:
+                raise ValueError(
+                    f"global batch {b} not divisible by grad_accumulation "
+                    f"{grad_accum}"
+                )
+            micro = b // grad_accum
+            # keep each micro-batch sharded exactly like the full batch
+            # (data [+ sequence] on the row dim) — without the constraint
+            # the partitioner may shard the scan axis instead, serializing
+            # the data parallelism
+            micro_spec = P(None, *_token_spec(mesh))
+            tok = jax.lax.with_sharding_constraint(
+                tokens.reshape(grad_accum, micro, seq),
+                NamedSharding(mesh, micro_spec),
             )
-            vocab = logits.shape[-1]
-            loss = cross_entropy_loss(
-                logits.reshape(-1, vocab), labels.reshape(-1), label_smoothing
+            lab = jax.lax.with_sharding_constraint(
+                labels.reshape(grad_accum, micro, seq),
+                NamedSharding(mesh, micro_spec),
             )
-            for path, leaf in jax.tree_util.tree_flatten_with_path(inter)[0]:
-                if any(
-                    str(getattr(key, "key", key)) == "moe_aux" for key in path
-                ):
-                    loss = loss + leaf
-            return loss
+            zero_g = jax.tree.map(jnp.zeros_like, state.params)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+            def scan_step(carry, xy):
+                acc, loss_acc = carry
+                loss, grads = jax.value_and_grad(loss_fn)(state.params, *xy)
+                return (jax.tree.map(jnp.add, acc, grads), loss_acc + loss), None
+
+            (grads, loss_sum), _ = jax.lax.scan(
+                scan_step, (zero_g, jnp.float32(0.0)), (tok, lab)
+            )
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+            loss = loss_sum / grad_accum
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, tokens, labels
+            )
         lr = lr_fn(state.opt_state.step)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
         return (

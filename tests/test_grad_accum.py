@@ -138,3 +138,119 @@ def test_indivisible_micro_batch_raises():
             jax.device_put(np.zeros((16, 32, 32, 3), np.float32), batch_sharding(mesh, 4)),
             jax.device_put(np.zeros((16,), np.int32), batch_sharding(mesh, 1)),
         )
+
+
+def test_tp_gspmd_accum_matches_plain():
+    """grad_accumulation on the GSPMD path (DPx2 x TPx4): N sequential
+    micro-batches under lax.scan == one full-batch step, loss AND params
+    (the micro sharding constraint must keep data parallelism intact)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import TrainState
+    from pytorch_distributed_training_tpu.engine.tp_steps import (
+        build_tp_lm_train_step,
+    )
+    from pytorch_distributed_training_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import make_mesh
+    from pytorch_distributed_training_tpu.parallel.tensor import (
+        tp_state_shardings,
+    )
+
+    vocab, seq, batch = 64, 16, 8
+    model = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=32, depth=2, num_heads=4,
+        seq_axis=None,
+    )
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    tokens, labels = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    mesh = make_mesh(model_parallelism=4)
+
+    def run(accum):
+        state = TrainState(
+            params=params, batch_stats={}, opt_state=opt.init(params)
+        )
+        state = jax.device_put(state, tp_state_shardings(state, mesh))
+        step = build_tp_lm_train_step(
+            model, opt, lambda _: jnp.float32(0.05), mesh, donate=False,
+            grad_accum=accum,
+        )(state)
+        state2, loss = step(state, tokens, labels)
+        return float(loss), jax.device_get(state2.params)
+
+    loss_plain, params_plain = run(1)
+    loss_acc, params_acc = run(2)
+    np.testing.assert_allclose(loss_acc, loss_plain, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(params_plain), jax.tree.leaves(params_acc)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
+def test_gspmd_accum_zero_and_moe_match_plain():
+    """The guard removal also enabled ZeRO and MoE accumulation — pin both:
+    ZeRO's data-sharded moments and MoE's routing must be invariant to the
+    micro split.  MoE exactness holds because routing is GROUP-local
+    (group = batch row, ops/moe.py) and micro-batching splits whole rows;
+    aux_weight=0 isolates that property (with aux on, the objective is the
+    mean of per-micro aux terms — documented accumulation semantics)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import TrainState
+    from pytorch_distributed_training_tpu.engine.tp_steps import (
+        build_tp_lm_train_step,
+    )
+    from pytorch_distributed_training_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import make_mesh
+    from pytorch_distributed_training_tpu.parallel.tensor import (
+        tp_state_shardings,
+    )
+
+    vocab, seq, batch = 64, 16, 8
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    tokens, labels = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+
+    def run(model, mesh, zero, accum):
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        state = TrainState(
+            params=params, batch_stats={}, opt_state=opt.init(params)
+        )
+        state = jax.device_put(state, tp_state_shardings(state, mesh, zero=zero))
+        step = build_tp_lm_train_step(
+            model, opt, lambda _: jnp.float32(0.05), mesh, donate=False,
+            zero=zero, grad_accum=accum,
+        )(state)
+        state2, loss = step(state, tokens, labels)
+        return float(loss), jax.device_get(state2.params)
+
+    dense = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=32, depth=2, num_heads=4,
+        seq_axis=None,
+    )
+    moe = dense.copy(
+        moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0, moe_aux_weight=0.0,
+        moe_every=2,
+    )
+    for name, model, mesh, zero in (
+        ("zero1", dense, make_mesh(model_parallelism=1), True),
+        ("moe-ep", moe, make_mesh(model_parallelism=4), False),
+    ):
+        l1, p1 = run(model, mesh, zero, 1)
+        l2, p2 = run(model, mesh, zero, 2)
+        np.testing.assert_allclose(l2, l1, atol=1e-5, err_msg=name)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=1e-5, err_msg=name
+            )
