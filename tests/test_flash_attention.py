@@ -59,9 +59,37 @@ def test_backward_matches_naive(causal):
         )
 
 
-def test_multi_k_block_online_softmax():
-    """S=384 = 3 K blocks of 128: the online-softmax rescaling across
-    blocks (m/l carry) is exercised, not just a single-block softmax."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_multi_k_block_online_softmax(causal):
+    """S=1536 = 3 K blocks of 512 x 6 Q tiles of 256 (the production
+    asymmetric tile pair): the online-softmax rescaling across K blocks
+    (m/l carry), the causal nj loop bound, and the dkv i0 start all run
+    multiple iterations — forward AND all three grads vs the naive
+    reference (the r2 review caught the 512 tile silently single-blocking
+    the old S=384 version of this test)."""
+    q, k, v = _qkv(seed=2, s=1536)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.sin(dot_product_attention(q, k, v, causal=causal))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_fa = jax.grad(
+        lambda q, k, v: jnp.sum(
+            jnp.sin(flash_attention(q, k, v, causal=causal, interpret=True))
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_ref, g_fa, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_halved_tile_fallback():
+    """S=384: bq falls back to 128 (256 does not divide) while bk becomes a
+    whole-array tile — the mixed fallback geometry must stay exact."""
     q, k, v = _qkv(seed=2, s=384)
     ref = dot_product_attention(q, k, v, causal=True)
     out = flash_attention(q, k, v, causal=True, interpret=True)
@@ -118,10 +146,17 @@ def test_inside_shard_map_with_grad():
     )
 
 
-def test_ragged_seq_rejected():
-    q, k, v = _qkv(seed=5, s=200)  # not divisible by the 128 block
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, causal=True, interpret=True)
+def test_block_picker_edge_lengths():
+    """Short ragged lengths run as one whole-array tile (s=200); lengths
+    with no 8-aligned power-of-two tiling are rejected with a clear error
+    (s=514 = 2x257 could only tile at 2 rows)."""
+    q, k, v = _qkv(seed=5, s=200)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    q2, k2, v2 = _qkv(seed=5, s=514)
+    with pytest.raises(ValueError, match="tile"):
+        flash_attention(q2, k2, v2, causal=True, interpret=True)
 
 
 def test_dispatch_gate_cpu_and_override():
