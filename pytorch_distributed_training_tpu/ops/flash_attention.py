@@ -232,13 +232,15 @@ def _pick_block(pref: int, s_len: int) -> int:
     b = min(pref, s_len)
     while b > 1 and s_len % b:
         b //= 2
-    # the loop guarantees b | s_len; the only remaining constraint is
-    # Mosaic's: multi-tile blocks must be 8-aligned (whole-array exempt)
+    # the loop guarantees b | s_len; Mosaic additionally requires
+    # multi-tile blocks to be 8-aligned — whole-array tiles are exempt, so
+    # a length with no 8-aligned power-of-two factor falls back to one
+    # whole-array tile (legal for ANY length; the auto-dispatch gates
+    # require s % 128 == 0 and bound VMEM, so only forced/test calls land
+    # here, and an oversized forced call fails at Mosaic compile like any
+    # other VMEM overflow)
     if b != s_len and b % 8:
-        raise ValueError(
-            f"flash_attention cannot tile seq {s_len} (needs a power-of-two "
-            f"factor >= 8 or a whole-array tile); use the XLA path"
-        )
+        b = s_len
     return b
 
 
