@@ -56,16 +56,34 @@ _NEG = -1e30  # finite mask value; see module docstring
 # 128-multiple (and tiny interpreter-test shapes) still works.
 _BLOCK_Q = 256
 _BLOCK_K = 512
-# VMEM budget for the kernels' resident K/V rows (f32): each instance holds
+# VMEM budget for the RESIDENT kernels' K/V rows (f32): each instance holds
 # 2 full [S, D] f32 operands plus tiles/accumulators; stay well under the
-# ~16MB scoped VMEM.  Single source of truth for every dispatch gate
-# (ops/attention.py local path AND parallel/sequence.py ring inner).
+# ~16MB scoped VMEM.  Sequences past this budget no longer fall back to the
+# naive O(S^2) path (the round-2 ceiling, VERDICT weak #5): they dispatch to
+# the STREAMED kernels below, which add the K/V position as an innermost
+# grid dimension so Pallas double-buffers [block, D] tiles through VMEM —
+# per-instance VMEM is then O(block*D) regardless of S, and single-chip
+# sequence length is bounded by HBM, not VMEM.
 _VMEM_BYTES = 8 * 1024 * 1024
+# lane width for the streamed kernels' m/l scratch rows (Mosaic wants the
+# minor dim to be a full 128-lane vector; values are lane-replicated)
+_LANES = 128
+
+
+def _resident_ok(s_len: int, d: int) -> bool:
+    """True when the tuned resident-K/V kernels fit scoped VMEM."""
+    import os
+
+    if os.environ.get("PDT_FLASH_FORCE_STREAM", "0") != "0":
+        return False
+    return 2 * s_len * d * 4 <= _VMEM_BYTES
 
 
 def flash_shapes_ok(s_len: int, d: int) -> bool:
-    """Shape/VMEM eligibility shared by all flash dispatch gates."""
-    return s_len >= 128 and s_len % 128 == 0 and 2 * s_len * d * 4 <= _VMEM_BYTES
+    """Shape eligibility shared by all flash dispatch gates (ops/attention.py
+    local path AND parallel/sequence.py ring inner).  No VMEM term anymore:
+    oversized sequences stream K/V tiles instead of falling back to XLA."""
+    return s_len >= 128 and s_len % 128 == 0
 
 
 def flash_enabled() -> bool:
@@ -223,6 +241,149 @@ def _dkv_kernel(
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+# ----------------------------------------------------------------------
+# Streamed kernels: K/V (resp. Q) positions ride the innermost grid dim,
+# so Pallas' pipeline streams [block, D] tiles through VMEM (automatic
+# double-buffered DMA) while the online-softmax state lives in VMEM scratch
+# that persists across innermost grid steps (TPU grids execute the minor
+# dimension sequentially).  Causal skipping is a `pl.when` on whole blocks
+# above the diagonal — the skipped tiles' DMA still streams (static grid),
+# so unlike the resident kernels the causal saving is compute-only.
+# ----------------------------------------------------------------------
+def _fwd_stream_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale, causal, block_q, block_k, nk,
+):
+    i = pl.program_id(1)  # Q tile (outer)
+    j = pl.program_id(2)  # K tile (inner, sequential)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    run = (j * block_k < (i + 1) * block_q) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+        kb = k_ref[0].astype(jnp.float32)  # [bk, d]
+        vb = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [bq, bk]
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        m_prev = m_scr[...]  # [bq, LANES] lane-replicated
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1)[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        m_scr[...] = m_new
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=-1)[:, None]
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[...]
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, :, 0] = (m_scr[...] + jnp.log(l))[:, 0]
+
+
+def _dq_stream_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, block_q, block_k, nk,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, jnp.float32)
+
+    run = (j * block_k < (i + 1) * block_q) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_stream_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k, nq,
+):
+    j = pl.program_id(1)  # K tile (outer)
+    i = pl.program_id(2)  # Q tile (inner, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    run = ((i + 1) * block_q > j * block_k) if causal else (i >= 0)
+
+    @pl.when(run)
+    def _compute():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        s = scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _pick_block(pref: int, s_len: int) -> int:
     """Largest power-of-two fraction of ``pref`` (clamped to ``s_len``)
     that divides ``s_len`` — seq 384 runs on 128-row tiles while seq 2048
@@ -249,15 +410,58 @@ def _blocks(s_len: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _make(causal: bool, interpret: bool, scale: float, out_f32: bool = False):
+def _make(
+    causal: bool, interpret: bool, scale: float, out_f32: bool = False,
+    stream: bool = False,
+):
     """Build the custom-VJP'd flash attention for a static (causal, mode,
-    scale, out-dtype) tuple — scale is a trace-time constant folded into the
-    kernels, and the cache sees only a handful of distinct head dims.
-    ``out_f32`` keeps the block output o in f32 regardless of input dtype
-    (the ring combine accumulates across blocks and must not round each
-    partial to bf16)."""
+    scale, out-dtype, stream) tuple — scale is a trace-time constant folded
+    into the kernels, and the cache sees only a handful of distinct head
+    dims.  ``out_f32`` keeps the block output o in f32 regardless of input
+    dtype (the ring combine accumulates across blocks and must not round
+    each partial to bf16).  ``stream`` selects the tile-streaming kernels
+    (VMEM O(block*D) instead of O(S*D); chosen by the S·D dispatch in
+    :func:`flash_attention_lse`)."""
+
+    def _forward_stream(q, k, v):
+        from jax.experimental.pallas import tpu as pltpu
+
+        bh, s_len, d = q.shape
+        bq, bk = _blocks(s_len)
+        nk = s_len // bk
+        kern = functools.partial(
+            _fwd_stream_kernel, scale=scale, causal=causal, block_q=bq,
+            block_k=bk, nk=nk,
+        )
+        qrow = lambda b, i, j: (b, i, 0)  # noqa: E731
+        krow = lambda b, i, j: (b, j, 0)  # noqa: E731
+        return pl.pallas_call(
+            kern,
+            grid=(bh, s_len // bq, nk),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), qrow),
+                pl.BlockSpec((1, bk, d), krow),
+                pl.BlockSpec((1, bk, d), krow),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, d), qrow),
+                pl.BlockSpec((1, bq, 1), qrow),
+            ],
+            out_shape=[
+                _out_struct(q.shape, jnp.float32 if out_f32 else q.dtype, q),
+                _out_struct((bh, s_len, 1), jnp.float32, q),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, _LANES), jnp.float32),
+                pltpu.VMEM((bq, _LANES), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v)
 
     def _forward(q, k, v):
+        if stream:
+            return _forward_stream(q, k, v)
         bh, s_len, d = q.shape
         bq, bk = _blocks(s_len)
         kern = functools.partial(
@@ -295,7 +499,75 @@ def _make(causal: bool, interpret: bool, scale: float, out_f32: bool = False):
         o, lse = _forward(q, k, v)
         return (o, lse), (q, k, v, o, lse)
 
+    def attn_bwd_stream(res, cts):
+        from jax.experimental.pallas import tpu as pltpu
+
+        q, k, v, o, lse = res
+        g, g_lse = cts
+        bh, s_len, d = q.shape
+        bq, bk = _blocks(s_len)
+        nq, nk = s_len // bq, s_len // bk
+        delta = jnp.sum(
+            g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+        )
+        delta = delta - g_lse.astype(jnp.float32)
+        qrow = lambda b, i, j: (b, i, 0)  # noqa: E731
+        krow = lambda b, i, j: (b, j, 0)  # noqa: E731
+        dq = pl.pallas_call(
+            functools.partial(
+                _dq_stream_kernel, scale=scale, causal=causal, block_q=bq,
+                block_k=bk, nk=nk,
+            ),
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), qrow),
+                pl.BlockSpec((1, bk, d), krow),
+                pl.BlockSpec((1, bk, d), krow),
+                pl.BlockSpec((1, bq, d), qrow),
+                pl.BlockSpec((1, bq, 1), qrow),
+                pl.BlockSpec((1, bq, 1), qrow),
+            ],
+            out_specs=pl.BlockSpec((1, bq, d), qrow),
+            out_shape=_out_struct(q.shape, q.dtype, q),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        # dK/dV: K tile outer, Q tile inner (index maps swap roles)
+        kout = lambda b, j, i: (b, j, 0)  # noqa: E731
+        qin = lambda b, j, i: (b, i, 0)  # noqa: E731
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _dkv_stream_kernel, scale=scale, causal=causal, block_q=bq,
+                block_k=bk, nq=nq,
+            ),
+            grid=(bh, nk, nq),
+            in_specs=[
+                pl.BlockSpec((1, bq, d), qin),
+                pl.BlockSpec((1, bk, d), kout),
+                pl.BlockSpec((1, bk, d), kout),
+                pl.BlockSpec((1, bq, d), qin),
+                pl.BlockSpec((1, bq, 1), qin),
+                pl.BlockSpec((1, bq, 1), qin),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, d), kout),
+                pl.BlockSpec((1, bk, d), kout),
+            ],
+            out_shape=[
+                _out_struct(k.shape, k.dtype, k),
+                _out_struct(v.shape, v.dtype, v),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, d), jnp.float32),
+                pltpu.VMEM((bk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, g, lse, delta)
+        return dq, dk, dv
+
     def attn_bwd(res, cts):
+        if stream:
+            return attn_bwd_stream(res, cts)
         q, k, v, o, lse = res
         g, g_lse = cts  # cotangents for (o, lse)
         bh, s_len, d = q.shape
@@ -404,9 +676,13 @@ def flash_attention_lse(
     def fold(x):
         return jnp.swapaxes(x, 1, 2).reshape(b * h, s_len, d)
 
-    out, lse = _make(bool(causal), bool(interpret), float(scale), bool(out_f32))(
-        fold(q), fold(k), fold(v)
-    )
+    # per-shape dispatch: tuned resident-K/V kernels while they fit scoped
+    # VMEM, tile-streaming kernels beyond (lifts the round-2 S<=8k@D=128
+    # single-chip ceiling; PDT_FLASH_FORCE_STREAM=1 forces streaming)
+    stream = not _resident_ok(s_len, d)
+    out, lse = _make(
+        bool(causal), bool(interpret), float(scale), bool(out_f32), bool(stream)
+    )(fold(q), fold(k), fold(v))
     out = jnp.swapaxes(out.reshape(b, h, s_len, d), 1, 2)
     lse = jnp.transpose(lse.reshape(b, h, s_len), (0, 2, 1))  # [B, S, H]
     return out, lse

@@ -171,3 +171,108 @@ def test_dispatch_gate_cpu_and_override():
     assert out.shape == q.shape
     with pytest.raises(ValueError, match="impl"):
         dot_product_attention(q, k, v, impl="pallas")
+
+
+# ----------------------------------------------------------------------
+# Streamed kernels (round-3: K/V tiles ride the innermost grid dim, VMEM
+# O(block*D) — lifts the resident kernels' S<=8k@D=128 ceiling).  Forced
+# via PDT_FLASH_FORCE_STREAM so CPU-sized shapes exercise the streaming
+# code path; real-TPU S=16384, D=128 fwd+bwd evidence is in PERF.md.
+# ----------------------------------------------------------------------
+@pytest.fixture
+def force_stream(monkeypatch):
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("PDT_FLASH_FORCE_STREAM", "1")
+    fa._make.cache_clear()
+    yield
+    fa._make.cache_clear()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streamed_forward_matches_naive(causal, force_stream):
+    # s=1024 with (256, 512) tiles: 4 Q tiles x 2 K tiles, so the streaming
+    # carry crosses a real K-tile boundary (online-softmax state in scratch)
+    q, k, v = _qkv(seed=7, s=1024)
+    ref = dot_product_attention(q, k, v, causal=causal, impl="xla")
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_streamed_backward_matches_naive(causal, force_stream):
+    q, k, v = _qkv(seed=8, s=1024)
+
+    def f(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    g_ref = jax.grad(
+        f(lambda q, k, v: dot_product_attention(q, k, v, causal=causal, impl="xla")),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g_fa = jax.grad(
+        f(lambda q, k, v: flash_attention(q, k, v, causal=causal, interpret=True)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_ref, g_fa, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_streamed_matches_resident_bitwise(force_stream):
+    """Same blocks, same f32 accumulate order => the streamed kernels are
+    not just close to the resident ones, they are IDENTICAL (the grid-dim
+    loop visits K tiles in the same order as the in-kernel fori_loop)."""
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(seed=9, s=512)
+    o_stream = np.asarray(flash_attention(q, k, v, causal=True, interpret=True))
+    fa._make.cache_clear()
+    import os
+
+    del os.environ["PDT_FLASH_FORCE_STREAM"]
+    o_res = np.asarray(flash_attention(q, k, v, causal=True, interpret=True))
+    np.testing.assert_array_equal(o_stream, o_res)
+
+
+def test_streamed_lse_grad(force_stream):
+    """The lse output and its cotangent path (ring-attention's combine
+    consumes lse) stay exact through the streamed backward kernels."""
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        flash_attention_lse,
+    )
+
+    q, k, v = _qkv(seed=10, s=1024)
+
+    def f_flash(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, causal=True, interpret=True)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(lse))
+
+    def f_ref(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((q.shape[1], q.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)  # [B,H,S]
+        p = jnp.exp(s - lse[..., None])
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(jnp.cos(jnp.transpose(lse, (0, 2, 1))))
+
+    g_fa = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_ref, g_fa, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_gate_no_longer_caps_sequence():
+    """flash_shapes_ok must accept sequences past the old resident-VMEM
+    ceiling (S=8192@D=128) — those dispatch to the streamed kernels now."""
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        flash_shapes_ok,
+    )
+
+    assert flash_shapes_ok(16384, 128)
+    assert flash_shapes_ok(65536, 128)
+    assert not flash_shapes_ok(100, 64)  # still requires s % 128 == 0
