@@ -81,13 +81,31 @@ class DistributedBatchNorm(nn.Module):
             for ax in reduce_axes:
                 local_n *= x.shape[ax]
             mean = jnp.mean(xf, axis=reduce_axes)
-            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
             n = local_n
             if self.axis_name is not None:
-                # Cross-replica sync: one fused pmean for (mean, E[x^2]).
+                # Cross-replica sync: one fused pmean for (mean, E[x^2]) —
+                # the same single-pass moments torch.nn.SyncBatchNorm
+                # allreduces, so the sync path matches torch's sync path.
+                mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
                 mean, mean_sq = jax.lax.pmean((mean, mean_sq), self.axis_name)
                 n = local_n * jax.lax.psum(1, self.axis_name)
-            var = mean_sq - jnp.square(mean)  # biased: used for normalization
+                var = mean_sq - jnp.square(mean)  # biased: for normalization
+            else:
+                # Local stats: SHIFTED single-pass moments,
+                # ``var = E[(x-c)^2] - (mean-c)^2`` with ``c`` = the running
+                # mean (constant, stop-gradient).  Exactly the biased batch
+                # variance in real arithmetic; in f32 the raw one-pass form
+                # (c=0) cancels catastrophically once ``mean^2 >> var``
+                # (post-ReLU activations deep in a net), while ``c`` close
+                # to the batch mean keeps both terms O(var) — two-pass
+                # accuracy (torch BatchNorm2d's algorithm) at single-pass
+                # HBM cost: x is still read once for stats, which is what
+                # keeps the bandwidth-bound ResNet step at its measured
+                # throughput (PERF.md).
+                c = jax.lax.stop_gradient(ra_mean.value)
+                var = jnp.mean(
+                    jnp.square(xf - c), axis=reduce_axes
+                ) - jnp.square(mean - c)
 
             if not self.is_initializing() and self.is_mutable_collection("batch_stats"):
                 unbiased = var * (n / max(n - 1, 1))
