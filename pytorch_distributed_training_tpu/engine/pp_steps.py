@@ -39,7 +39,7 @@ from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer_lm import DecoderBlock
-from ..parallel.mesh import DATA_AXIS
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 from ..parallel.pipeline import STAGE_AXIS, pp_param_specs
 from ..parallel.tensor import mirror_opt_fields
 from ..utils.vma import mark_varying
@@ -85,6 +85,95 @@ def _stage_applies(model):
     return embed, apply_blocks, apply_head
 
 
+def _sim_1f1b(n_micro: int, n_stages: int):
+    """Static 1F1B (PipeDream-Flush) tick schedule, event-simulated.
+
+    Every tick each stage has one F slot and one B slot (the compiled tick
+    body always executes both, masked — SPMD lockstep).  A stage runs its
+    next forward when the previous stage finished that microbatch at a
+    strictly earlier tick AND its in-flight count is under the 1F1B window
+    ``n_stages - s`` (the property that caps activation memory at O(S)
+    microbatches instead of GPipe's O(M)); it runs its next backward when
+    its own forward and the next stage's backward for that microbatch are
+    done.  Greedy earliest-tick scheduling of those dependencies IS 1F1B:
+    the window forces backwards to interleave as soon as they unblock.
+
+    Returns ``(f_mb, f_on, b_mb, b_on, depth)``: [T, S] int/bool arrays
+    (tick t, stage s) plus the ring-buffer depth the activation buffers
+    need (max concurrently-live intervals measured on the simulated
+    schedule — FIFO per stage, so ``mb % depth`` slots cannot collide).
+    """
+    M, S = int(n_micro), int(n_stages)
+    fwd_done = [[-1] * M for _ in range(S)]
+    bwd_done = [[-1] * M for _ in range(S)]
+    next_f, next_b = [0] * S, [0] * S
+    rows_f, rows_b = [], []
+    t = 0
+    while any(nb < M for nb in next_b):
+        f_row, b_row = [], []
+        for s in range(S):
+            m = next_f[s]
+            can_f = (
+                m < M
+                and (s == 0 or (0 <= fwd_done[s - 1][m] < t))
+                and (next_f[s] - next_b[s]) < (S - s)
+            )
+            mb = next_b[s]
+            can_b = (
+                mb < M
+                and 0 <= fwd_done[s][mb] < t
+                and (s == S - 1 or (0 <= bwd_done[s + 1][mb] < t))
+            )
+            f_row.append((m if can_f else 0, can_f))
+            b_row.append((mb if can_b else 0, can_b))
+        for s in range(S):
+            m, on = f_row[s]
+            if on:
+                fwd_done[s][m] = t
+                next_f[s] += 1
+            m, on = b_row[s]
+            if on:
+                bwd_done[s][m] = t
+                next_b[s] += 1
+        rows_f.append(f_row)
+        rows_b.append(b_row)
+        t += 1
+        if t > 4 * (M + S) + 8:
+            raise AssertionError("1F1B schedule simulation did not converge")
+
+    T = t
+
+    def max_overlap(intervals):
+        """Max number of [a, c] intervals alive at any tick."""
+        best = 0
+        for tick in range(T + 1):
+            best = max(best, sum(1 for a, c in intervals if a <= tick <= c))
+        return best
+
+    depth = 1
+    for s in range(S):
+        # x arrival (prev stage's fwd) .. consumed by this stage's bwd
+        arr = [
+            ((fwd_done[s - 1][m] if s else fwd_done[s][m]), bwd_done[s][m])
+            for m in range(M)
+        ]
+        # dy arrival (next stage's bwd) .. consumed by this stage's bwd
+        dy = (
+            [(bwd_done[s + 1][m], bwd_done[s][m]) for m in range(M)]
+            if s < S - 1
+            else []
+        )
+        # saved x_in: written at this stage's fwd .. read at its bwd
+        sav = [(fwd_done[s][m], bwd_done[s][m]) for m in range(M)]
+        depth = max(depth, max_overlap(arr), max_overlap(dy), max_overlap(sav))
+
+    f_mb = np.array([[r[s][0] for s in range(S)] for r in rows_f], np.int32)
+    f_on = np.array([[r[s][1] for s in range(S)] for r in rows_f], bool)
+    b_mb = np.array([[r[s][0] for s in range(S)] for r in rows_b], np.int32)
+    b_on = np.array([[r[s][1] for s in range(S)] for r in rows_b], bool)
+    return f_mb, f_on, b_mb, b_on, depth
+
+
 def _schedule(n_micro: int, n_stages: int):
     """Static GPipe tick schedule: (feed index, emit index, emit mask).
 
@@ -112,14 +201,35 @@ def build_pp_lm_train_step(
     num_microbatches: int,
     donate: bool = True,
     label_smoothing: float = 0.0,
+    schedule: str = "gpipe",
 ):
-    """Compile one DP x PP LM iteration.
+    """Compile one DP x PP (optionally x TP) LM iteration.
 
     ``model``: a :class:`TransformerLM` (``seq_axis=None``); its params must
     be in the pipeline layout (:func:`..parallel.pipeline.pp_stack_params`).
     The optimizer must be elementwise per-leaf (SGD / AdamW — LARS computes
     per-parameter norms, which would span the stacked layer axis and change
     semantics; the Runner rejects that combination).
+
+    ``schedule``:
+      - ``"gpipe"``: forward scan differentiated by autodiff (module
+        docstring) — activation residuals for all M+S-1 ticks stay live
+        through the backward, O(M) microbatch activations per stage.
+      - ``"1f1b"``: manual interleaved schedule (:func:`_sim_1f1b`) with a
+        hand-written backward: each tick runs one masked forward slot and
+        one masked backward slot; the backward slot re-runs its stage's
+        forward under ``jax.vjp`` at the saved stage INPUT (recompute —
+        only O(S) microbatch inputs are ever buffered, 1F1B's memory
+        property) and pulls the activation cotangent backwards along the
+        reverse ring.  Same update math as gpipe to float tolerance
+        (tests/test_pipeline_parallel.py pins both against the single-chip
+        oracle).
+
+    If ``mesh`` also carries a ``model`` axis (size > 1), the step runs
+    shard_map-manual over (data, stage) only and leaves ``model`` to the
+    GSPMD partitioner — Megatron tensor parallelism INSIDE each pipeline
+    stage, from the same sharding rules as the pure-TP path
+    (parallel/tensor.py); see :func:`..parallel.pipeline.pp_tp_state_shardings`.
 
     Returns ``compile_for(state)`` pinning the state's stage shardings,
     mirroring :func:`..engine.tp_steps.build_tp_lm_train_step`.
@@ -129,6 +239,8 @@ def build_pp_lm_train_step(
     M = int(num_microbatches)
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     embed, apply_blocks, apply_head = _stage_applies(model)
     feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
 
@@ -183,15 +295,147 @@ def build_pp_lm_train_step(
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
         return new_params, new_opt, loss
 
+    def body_1f1b(params, opt_state, tokens, labels):
+        b_local, seq = tokens.shape
+        if b_local % M != 0:
+            raise ValueError(
+                f"per-shard batch {b_local} not divisible by "
+                f"num_microbatches {M}"
+            )
+        mb = b_local // M
+        global_tokens = b_local * seq * n_data
+        stage = jax.lax.axis_index(STAGE_AXIS)
+        is_last = stage == n_stages - 1
+        tok = tokens.reshape(M, mb, seq)
+        lab = labels.reshape(M, mb, seq)
+        perm_f = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+        perm_b = [(s, (s - 1) % n_stages) for s in range(n_stages)]
+
+        f_mb, f_on, b_mb, b_on, W = _sim_1f1b(M, n_stages)
+        # receive-side schedules: what arrives THIS tick is whatever the
+        # neighbor's slot ran this tick (the ppermute happens in-tick);
+        # stage 0 never receives activations, the last never receives dy
+        fr_mb = np.roll(f_mb, 1, axis=1)
+        fr_on = np.roll(f_on, 1, axis=1)
+        fr_on[:, 0] = False
+        br_mb = np.roll(b_mb, -1, axis=1)
+        br_on = np.roll(b_on, -1, axis=1)
+        br_on[:, -1] = False
+        sched = jax.tree.map(
+            jnp.asarray, (f_mb, f_on, b_mb, b_on, fr_mb, fr_on, br_mb, br_on)
+        )
+
+        def stage_fn(p, tok_mb, lab_mb, x_recv):
+            inj = embed(p["shared"], tok_mb)
+            x_in = jnp.where(stage == 0, inj, x_recv)
+            y = apply_blocks(p["blocks"], x_in)
+            logits = apply_head(p["shared"], y)
+            part = lm_loss_local(logits, lab_mb, global_tokens, label_smoothing)
+            return y, jnp.where(is_last, part, 0.0)
+
+        def sel(row):
+            return jnp.take(row, stage, axis=0)
+
+        def tick(carry, xs):
+            x_buf, dy_buf, x_saved, gacc, loss_acc = carry
+            fm, fo, bm, bo, frm, fro, brm, bro = (sel(r) for r in xs)
+
+            # ---- forward slot (masked by fo) ----
+            x_in = x_buf[fm % W]
+            x_saved = jnp.where(fo, x_saved.at[fm % W].set(x_in), x_saved)
+            y, lo = stage_fn(params, tok[fm], lab[fm], x_in)
+            loss_acc = loss_acc + jnp.where(fo & is_last, lo, 0.0)
+            y_recv = jax.lax.ppermute(y, STAGE_AXIS, perm_f)
+            x_buf = jnp.where(fro, x_buf.at[frm % W].set(y_recv), x_buf)
+
+            # ---- backward slot (masked by bo): recompute-vjp at the saved
+            # stage input, seed (dy from the next stage, dloss = 1).
+            # MASKING GOES INTO THE SEEDS, not onto dp: shard_map AD psums
+            # the cotangent of any mesh-invariant primal (shared params are
+            # (data, stage)-invariant, block params data-invariant), so dp
+            # comes back ALREADY reduced across devices each tick — an
+            # after-the-fact `where(bo, dp, 0)` would keep other stages'
+            # garbage and re-psumming would double-count.  A zero seed on an
+            # inactive stage zeroes its contribution inside the transpose,
+            # which is exactly the per-stage mask.
+            xs_in = x_saved[bm % W]
+            dy_in = jnp.where(
+                is_last | ~bo, jnp.zeros_like(xs_in), dy_buf[bm % W]
+            )
+            _, vjp_fn = jax.vjp(
+                lambda p_, xr: stage_fn(p_, tok[bm], lab[bm], xr), params, xs_in
+            )
+            cts = mark_varying(
+                (
+                    dy_in.astype(model.dtype),
+                    jnp.where(bo, jnp.float32(1.0), jnp.float32(0.0)),
+                ),
+                (DATA_AXIS, STAGE_AXIS),
+            )
+            dp, dx = vjp_fn(cts)
+            gacc = jax.tree.map(jnp.add, gacc, dp)
+            dx_recv = jax.lax.ppermute(dx, STAGE_AXIS, perm_b)
+            dy_buf = jnp.where(bro, dy_buf.at[brm % W].set(dx_recv), dy_buf)
+            return (x_buf, dy_buf, x_saved, gacc, loss_acc), None
+
+        act = (W, mb, seq, model.embed_dim)
+        # gacc's vma must mirror what the vjp hands back (see seed-masking
+        # comment): block grads come back data-psummed (varying over stage
+        # only), shared grads fully reduced (invariant) — the activation
+        # buffers and the loss are genuinely per-device
+        gacc0 = {
+            "blocks": mark_varying(
+                jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params["blocks"]
+                ),
+                (STAGE_AXIS,),
+            ),
+            "shared": jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params["shared"]
+            ),
+        }
+        carry0 = (
+            *mark_varying(
+                (
+                    jnp.zeros(act, model.dtype),
+                    jnp.zeros(act, model.dtype),
+                    jnp.zeros(act, model.dtype),
+                ),
+                (DATA_AXIS, STAGE_AXIS),
+            ),
+            gacc0,
+            mark_varying(jnp.float32(0.0), (DATA_AXIS, STAGE_AXIS)),
+        )
+        (_, _, _, gacc, loss_sum), _ = jax.lax.scan(tick, carry0, sched)
+
+        # no explicit grad collectives: the per-tick vjp transpose already
+        # psummed each cotangent to its primal's invariance (blocks over
+        # data, shared over data AND stage — see the seed-masking comment),
+        # so gacc IS the fully-reduced gradient after the scan
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
+        loss = jax.lax.psum(loss_sum, (DATA_AXIS, STAGE_AXIS))
+        lr = lr_fn(opt_state.step)
+        new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
+        return new_params, new_opt, loss
+
+    step_body = body if schedule == "gpipe" else body_1f1b
+
     def compile_for(state: TrainState):
         param_spec = pp_param_specs(state.params)
         opt_spec = _opt_specs(state, param_spec)
         tok_spec = P(DATA_AXIS, None)
+        # PP x TP: leave the 'model' axis to the GSPMD partitioner (manual
+        # over data/stage only) — Megatron splits inside each stage, from
+        # the sharded params' own NamedShardings
+        manual = {}
+        if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+            manual = dict(axis_names=frozenset({DATA_AXIS, STAGE_AXIS}))
         sharded = jax.shard_map(
-            body,
+            step_body,
             mesh=mesh,
             in_specs=(param_spec, opt_spec, tok_spec, tok_spec),
             out_specs=(param_spec, opt_spec, P()),
+            **manual,
         )
 
         def step(state: TrainState, tokens, labels):
@@ -238,6 +482,18 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
         # any per-shard batch: fall back to the largest microbatch count
         # that divides it (a tail batch recompiles anyway — new shape)
         M = math.gcd(M_cfg, b_local)
+        if M != M_cfg:
+            # a tail batch coprime with M_cfg degenerates to M=1 (one
+            # whole-batch microbatch: an activation-memory spike and a
+            # fully serial pipeline tick pattern) — surface it (trace-time,
+            # once per distinct tail shape; round-2 ADVICE)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pp eval: per-shard tail batch %d not divisible by "
+                "microbatches %d; falling back to M=%d for this batch",
+                b_local, M_cfg, M,
+            )
         feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
         mb = b_local // M
         global_tokens = b_local * seq * n_data
@@ -284,11 +540,15 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
     def compile_for(state: TrainState):
         param_spec = pp_param_specs(state.params)
         tok_spec = P(DATA_AXIS, None)
+        manual = {}
+        if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
+            manual = dict(axis_names=frozenset({DATA_AXIS, STAGE_AXIS}))
         sharded = jax.shard_map(
             body,
             mesh=mesh,
             in_specs=(param_spec, tok_spec, tok_spec),
             out_specs=(P(), P(), P()),
+            **manual,
         )
 
         @jax.jit

@@ -207,10 +207,28 @@ class Runner:
                 "training.sequence_parallelism / tensor_parallelism / "
                 "pipeline_parallelism require model.name: TransformerLM"
             )
-        if self.pipe_par > 1 and (self.seq_par > 1 or self.tensor_par > 1):
+        if self.pipe_par > 1 and self.seq_par > 1:
+            # PP's per-tick ppermute moves whole-microbatch activations; the
+            # ring-attention path would need a second in-tick collective
+            # schedule over the sequence axis — not wired (PP x TP is)
             raise ValueError(
                 "pipeline_parallelism does not compose with "
-                "sequence/tensor parallelism yet"
+                "sequence_parallelism yet (pipeline_parallelism x "
+                "tensor_parallelism is supported)"
+            )
+        # Additive key ``training.pp_schedule``: microbatch schedule for the
+        # pipeline step — "gpipe" (autodiff backward, O(M) activation
+        # residuals) or "1f1b" (manual interleaved backward with per-stage
+        # recompute, O(S) buffered microbatch inputs; engine/pp_steps.py).
+        self.pp_schedule = str(train_cfg.get("pp_schedule", "gpipe"))
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"training.pp_schedule must be 'gpipe' or '1f1b', "
+                f"got {self.pp_schedule!r}"
+            )
+        if "pp_schedule" in train_cfg and self.pipe_par <= 1:
+            raise ValueError(
+                "training.pp_schedule requires pipeline_parallelism > 1"
             )
         if self.pipe_par > 1 and self.is_moe:
             # MoE blocks break the homogeneous stacked-layer layout the
@@ -520,7 +538,14 @@ class Runner:
                     "pipeline_parallelism (per-parameter trust ratios do not "
                     "survive the stacked-layer param layout)"
                 )
-            self.mesh = make_pp_mesh(self.pipe_par)
+            if self.tensor_par > 1 and self.model.num_heads % self.tensor_par:
+                # same whole-head Megatron split constraint as the TP path
+                raise ValueError(
+                    f"model.num_heads ({self.model.num_heads}) must be "
+                    f"divisible by training.tensor_parallelism "
+                    f"({self.tensor_par})"
+                )
+            self.mesh = make_pp_mesh(self.pipe_par, self.tensor_par)
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
             pp_params = pp_stack_params(params, self.model.depth)
@@ -534,6 +559,7 @@ class Runner:
                 self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
                 num_microbatches=self.microbatches,
                 label_smoothing=self.label_smoothing,
+                schedule=self.pp_schedule,
             )(self.state)
             self.eval_step = build_pp_lm_eval_step(
                 self.model, self.mesh, self.microbatches

