@@ -79,7 +79,45 @@ class Checkpointer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             state,
         )
-        restored = self._manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self._manager.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except Exception as e:
+            # A params-layout mismatch (e.g. a checkpoint saved under
+            # pipeline_parallelism — stacked {blocks, shared} — restored
+            # into a non-PP run's {block0..blockN} tree, or vice versa)
+            # surfaces from orbax as a cryptic structure error; name the
+            # actual problem and the conversion helpers (round-2 ADVICE).
+            # Only claim a layout mismatch when the error actually looks
+            # structural — IO/corruption failures re-raise untouched.
+            msg = str(e).lower()
+            structural = any(
+                k in msg
+                for k in ("structure", "tree", "pytree", "missing", "not found",
+                          "does not match", "mismatch", "key")
+            )
+            if not structural:
+                raise
+
+            def _layout(tree):
+                try:
+                    keys = set(tree.params.keys())
+                except Exception:
+                    return "<unknown>"
+                if {"blocks", "shared"} <= keys:
+                    return "pipeline (stacked {blocks, shared})"
+                return "per-layer ({block0..blockN, ...} / image-model tree)"
+
+            raise RuntimeError(
+                f"checkpoint at {self.directory} (iter {step}) does not match "
+                f"the run's state layout [{_layout(state)}]. If the "
+                "checkpoint was written under a different "
+                "training.pipeline_parallelism setting, convert it with "
+                "parallel.pipeline.pp_stack_params / pp_unstack_params "
+                "before resuming, or resume with the original setting. "
+                f"Underlying error: {e}"
+            ) from e
         if logger:
             logger.info("Restored checkpoint at iter %d from %s", step, self.directory)
         return restored, step + 1

@@ -58,16 +58,30 @@ class _RecordingTB:
 
 
 class _RecordingRunner(Runner):
-    """Runner that additionally records the per-iteration loss scalar."""
+    """Runner that additionally records the per-iteration loss scalar, and
+    can deliver a SIGTERM to ITSELF at a configured iteration (simulating a
+    spot eviction landing on exactly one host — the multi-process
+    preemption-agreement path, runner._globally_preempted)."""
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.losses = []
+        self._self_preempt_at = int(os.environ.get("MH_SELF_PREEMPT_AT", "-1"))
+        self._self_preempt_rank = int(
+            os.environ.get("MH_SELF_PREEMPT_RANK", "-1")
+        )
 
     def train_iter(self, g_img, g_label):
         self.state, loss = self.train_step(self.state, g_img, g_label)
         self.losses.append(float(loss))
         self.scheduler.step()  # per-iteration, reference :299
+        if (
+            self.iter == self._self_preempt_at
+            and self.current_rank == self._self_preempt_rank
+        ):
+            import signal
+
+            os.kill(os.getpid(), signal.SIGTERM)
 
 
 def main():
@@ -95,9 +109,26 @@ def main():
         }
         model = {"name": "ResNet18"}
         extra = {}
+    ckpt_dir = os.environ.get("MH_CKPT_DIR")
+    ckpt = (
+        {
+            "checkpoint": {
+                "dir": ckpt_dir,
+                # huge regular interval: only the preemption path (or the
+                # final iteration) writes, so the test can attribute saves
+                "interval": int(os.environ.get("MH_CKPT_INTERVAL", "100000")),
+                "preemption_sync_interval": int(
+                    os.environ.get("MH_PREEMPT_SYNC", "2")
+                ),
+            }
+        }
+        if ckpt_dir
+        else {}
+    )
     cfg = {
         "dataset": dataset,
         "training": {
+            **ckpt,
             "optimizer": {
                 "name": "SGD",
                 # small lr: keeps the 4-step trajectory out of the chaotic
@@ -108,7 +139,7 @@ def main():
                 "momentum": 0.9,
             },
             "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
-            "train_iters": 4,
+            "train_iters": int(os.environ.get("MH_TRAIN_ITERS", "4")),
             "print_interval": 1,
             "val_interval": 100,  # is_val still fires on the last iter (p3)
             "batch_size": 16,
@@ -144,6 +175,7 @@ def main():
                 "world_size": runner.world_size,
                 "global_batch": runner.global_batch,
                 "losses": runner.losses,
+                "final_iter": runner.iter,
                 "eval": {t: v for t, v, _ in tb.scalars if t.startswith("eval/")},
                 "param_bytes_digest": __import__("hashlib").sha256(
                     b"".join(p.tobytes() for p in params)
