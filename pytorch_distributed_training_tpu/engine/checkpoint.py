@@ -89,16 +89,23 @@ class Checkpointer:
             # into a non-PP run's {block0..blockN} tree, or vice versa)
             # surfaces from orbax as a cryptic structure error; name the
             # actual problem and the conversion helpers (round-2 ADVICE).
-            # Only claim a layout mismatch when the error actually looks
-            # structural — IO/corruption failures re-raise untouched.
-            msg = str(e).lower()
-            structural = any(
-                k in msg
-                for k in ("structure", "tree", "pytree", "missing", "not found",
-                          "does not match", "mismatch", "key")
-            )
-            if not structural:
+            # Structural-vs-IO is decided from the checkpoint's own stored
+            # tree structure (item metadata), NOT from error-message
+            # keywords: if the saved structure matches the target, the
+            # failure is corruption/IO and the original error re-raises
+            # untouched (a keyword heuristic misfired here — orbax
+            # corruption errors also say "not found").
+            if not self._structure_differs(step, state):
                 raise
+            # Structural mismatch: if it is the known PP <-> per-layer
+            # params relayout (a checkpoint written under a different
+            # training.pipeline_parallelism setting), convert in place —
+            # resuming across a topology change is routine on preemptible
+            # capacity.  Anything else falls through to the descriptive
+            # error.
+            converted = self._restore_converting_layout(step, state, logger)
+            if converted is not None:
+                return converted, step + 1
 
             def _layout(tree):
                 try:
@@ -111,16 +118,171 @@ class Checkpointer:
 
             raise RuntimeError(
                 f"checkpoint at {self.directory} (iter {step}) does not match "
-                f"the run's state layout [{_layout(state)}]. If the "
-                "checkpoint was written under a different "
-                "training.pipeline_parallelism setting, convert it with "
-                "parallel.pipeline.pp_stack_params / pp_unstack_params "
+                f"the run's state layout [{_layout(state)}] and automatic "
+                "PP<->per-layer conversion did not apply. If the checkpoint "
+                "was written under a different training setting, convert it "
+                "with parallel.pipeline.pp_stack_params / pp_unstack_params "
                 "before resuming, or resume with the original setting. "
                 f"Underlying error: {e}"
             ) from e
         if logger:
             logger.info("Restored checkpoint at iter %d from %s", step, self.directory)
         return restored, step + 1
+
+    def _structure_differs(self, step, state) -> bool:
+        """Whether the checkpoint's SAVED pytree structure differs from the
+        target ``state``'s — from orbax item metadata, so the verdict does
+        not depend on parsing error strings.  Unreadable metadata counts as
+        'no structural evidence' (False): the restore error re-raises."""
+        try:
+            meta = self._manager.item_metadata(step)
+            saved_paths = {
+                tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
+                for p, _ in jax.tree_util.tree_flatten_with_path(meta)[0]
+            }
+            want_paths = {
+                tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
+                for p, _ in jax.tree_util.tree_flatten_with_path(state)[0]
+            }
+            return saved_paths != want_paths
+        except Exception:
+            return False
+
+    def _restore_converting_layout(self, step, state, logger=None):
+        """Restore a checkpoint whose *params layout* is the pipeline
+        counterpart of ``state``'s (stacked ``{blocks, shared}`` vs
+        per-layer ``{block0..blockN, ...}``) and convert it into
+        ``state``'s layout — params AND every optimizer-moment tree that
+        mirrors them (SGD momentum, AdamW mu/nu).  Returns the converted
+        state, or ``None`` when the mismatch is not this relayout (caller
+        falls through to the descriptive error)."""
+        import orbax.checkpoint as ocp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.pipeline import pp_stack_params, pp_unstack_params
+
+        params = getattr(state, "params", None)
+        if not isinstance(params, dict):
+            return None
+        keys = set(params.keys())
+        target_pp = {"blocks", "shared"} <= keys
+        flat_blocks = sorted(
+            k for k in keys if k.startswith("block") and k != "blocks"
+        )
+        if not target_pp and not flat_blocks:
+            return None
+
+        sh0 = jax.tree.leaves(state)[0].sharding
+        mesh = sh0.mesh if isinstance(sh0, jax.sharding.NamedSharding) else None
+
+        # Abstract shardings are DERIVED from the target leaf's, not
+        # replicated: a stacked-params run whose state only fits sharded
+        # must not materialize the whole checkpoint on every device during
+        # conversion.  Stacking/unstacking adds/removes the leading layer
+        # dim, so specs shift by one position; mesh axes that disappear
+        # with the layer dim (the stage axis) drop to replication for the
+        # transient restore, everything else keeps its placement.
+        def _shifted(l, drop_leading: bool):
+            if mesh is None:
+                return l.sharding
+            spec = tuple(l.sharding.spec) + (None,) * (
+                l.ndim - len(l.sharding.spec)
+            )
+            spec = spec[1:] if drop_leading else (None,) + spec
+            return NamedSharding(mesh, P(*spec))
+
+        def sds(shape, dtype, sharding):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+        def like(tree):
+            return jax.tree.map(
+                lambda l: sds(l.shape, l.dtype, l.sharding), tree
+            )
+
+        if target_pp:
+            # checkpoint should be per-layer: unstack the abstract shapes
+            depth = jax.tree.leaves(params["blocks"])[0].shape[0]
+
+            def other(p):
+                out = {k: like(v) for k, v in p["shared"].items()}
+                for _i in range(depth):
+                    out[f"block{_i}"] = jax.tree.map(
+                        lambda l: sds(
+                            l.shape[1:], l.dtype, _shifted(l, True)
+                        ),
+                        p["blocks"],
+                    )
+                return out
+
+            def convert(tree):
+                return pp_stack_params(tree, depth)
+
+        else:
+            # checkpoint should be stacked: stack the abstract shapes
+            depth = len(flat_blocks)
+
+            def other(p):
+                return {
+                    "blocks": jax.tree.map(
+                        lambda l: sds(
+                            (depth,) + l.shape, l.dtype, _shifted(l, False)
+                        ),
+                        p["block0"],
+                    ),
+                    "shared": {
+                        k: like(v)
+                        for k, v in p.items()
+                        if not k.startswith("block")
+                    },
+                }
+
+            def convert(tree):
+                return pp_unstack_params(tree, depth)
+
+        params_struct = jax.tree.structure(params)
+        opt = state.opt_state
+        abstract_opt = {}
+        for name in opt._fields:
+            field = getattr(opt, name)
+            if jax.tree.structure(field) == params_struct:
+                abstract_opt[name] = other(field)
+            else:
+                abstract_opt[name] = like(field)
+        abstract = state.replace(
+            params=other(params),
+            opt_state=type(opt)(**abstract_opt),
+            batch_stats=like(state.batch_stats),
+            ema=like(state.ema),
+        )
+        try:
+            restored = self._manager.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+        except Exception:
+            return None  # not the PP relayout — let the caller explain
+        new_opt = {}
+        for name in opt._fields:
+            field = getattr(restored.opt_state, name)
+            if jax.tree.structure(getattr(opt, name)) == params_struct:
+                new_opt[name] = convert(field)
+            else:
+                new_opt[name] = field
+        out = state.replace(
+            params=convert(restored.params),
+            opt_state=type(opt)(**new_opt),
+            batch_stats=restored.batch_stats,
+            ema=restored.ema,
+        )
+        out = jax.device_put(out, jax.tree.map(lambda x: x.sharding, state))
+        if logger:
+            logger.info(
+                "Restored checkpoint at iter %d from %s, CONVERTING params "
+                "layout (%s -> %s, depth %d)",
+                step, self.directory,
+                "per-layer" if target_pp else "stacked",
+                "stacked" if target_pp else "per-layer", depth,
+            )
+        return out
 
     def wait(self) -> None:
         self._manager.wait_until_finished()

@@ -49,16 +49,20 @@ from .steps import TrainState
 __all__ = ["build_pp_lm_train_step", "build_pp_lm_eval_step"]
 
 
-def _stage_applies(model):
+def _stage_applies(model, seq_axis=None):
     """(embed, blocks, head) closures over a TransformerLM's hyperparams.
 
     Reuses the model's own flax modules for the shared pieces so the math is
     bit-identical to ``TransformerLM.__call__`` (models/transformer_lm.py).
+    With ``seq_axis`` set (PP x SP), each stage's blocks run ring attention
+    over that mesh axis and the positional embedding is sliced to the
+    sequence shard — the same construction TransformerLM applies when its
+    own ``seq_axis`` is set (models/transformer_lm.py:119-130).
     """
     block = DecoderBlock(
         num_heads=model.num_heads,
         mlp_ratio=model.mlp_ratio,
-        seq_axis=None,
+        seq_axis=seq_axis,
         seq_impl=model.seq_impl,
         dtype=model.dtype,
     )
@@ -67,7 +71,14 @@ def _stage_applies(model):
 
     def embed(shared, tokens):
         x = jnp.take(shared["tok_embedding"], tokens, axis=0).astype(model.dtype)
-        pe = shared["pos_embedding"][: tokens.shape[1]]
+        s = tokens.shape[1]
+        if seq_axis is None:
+            pe = shared["pos_embedding"][:s]
+        else:
+            off = jax.lax.axis_index(seq_axis) * s
+            pe = jax.lax.dynamic_slice_in_dim(
+                shared["pos_embedding"], off, s, axis=0
+            )
         return x + pe[None].astype(model.dtype)
 
     def apply_blocks(blocks_local, x):
@@ -202,6 +213,7 @@ def build_pp_lm_train_step(
     donate: bool = True,
     label_smoothing: float = 0.0,
     schedule: str = "gpipe",
+    seq_axis=None,
 ):
     """Compile one DP x PP (optionally x TP) LM iteration.
 
@@ -236,12 +248,14 @@ def build_pp_lm_train_step(
     """
     n_stages = mesh.shape[STAGE_AXIS]
     n_data = mesh.shape[DATA_AXIS]
+    n_seq = mesh.shape[seq_axis] if seq_axis else 1
+    loss_axes = (DATA_AXIS, STAGE_AXIS) + ((seq_axis,) if seq_axis else ())
     M = int(num_microbatches)
     if M < 1:
         raise ValueError(f"num_microbatches must be >= 1, got {M}")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
-    embed, apply_blocks, apply_head = _stage_applies(model)
+    embed, apply_blocks, apply_head = _stage_applies(model, seq_axis)
     feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
 
     def body(params, opt_state, tokens, labels):
@@ -252,7 +266,11 @@ def build_pp_lm_train_step(
                 f"num_microbatches {M}"
             )
         mb = b_local // M
-        global_tokens = b_local * seq * n_data
+        if seq * n_seq > model.max_len:
+            raise ValueError(
+                f"global sequence {seq * n_seq} exceeds max_len {model.max_len}"
+            )
+        global_tokens = b_local * seq * n_data * n_seq
         stage = jax.lax.axis_index(STAGE_AXIS)
         tok = tokens.reshape(M, mb, seq)
         lab = labels.reshape(M, mb, seq)
@@ -279,16 +297,17 @@ def build_pp_lm_train_step(
             x0, l0 = mark_varying(
                 (jnp.zeros((mb, seq, model.embed_dim), model.dtype),
                  jnp.float32(0.0)),
-                (DATA_AXIS, STAGE_AXIS),
+                loss_axes,
             )
             (_, loss_sum), _ = jax.lax.scan(
                 tick, (x0, l0), (feed_idx, emit_idx, emit_valid)
             )
             # global mean CE as a replicated scalar: only the last stage
             # holds nonzero partials, the psum both totals them over data
-            # and broadcasts over stage — differentiating THIS is what makes
-            # the pipeline backward exact (module docstring)
-            return jax.lax.psum(loss_sum, (DATA_AXIS, STAGE_AXIS))
+            # (and sequence, under PP x SP) and broadcasts over stage —
+            # differentiating THIS is what makes the pipeline backward
+            # exact (module docstring)
+            return jax.lax.psum(loss_sum, loss_axes)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         lr = lr_fn(opt_state.step)
@@ -303,7 +322,11 @@ def build_pp_lm_train_step(
                 f"num_microbatches {M}"
             )
         mb = b_local // M
-        global_tokens = b_local * seq * n_data
+        if seq * n_seq > model.max_len:
+            raise ValueError(
+                f"global sequence {seq * n_seq} exceeds max_len {model.max_len}"
+            )
+        global_tokens = b_local * seq * n_data * n_seq
         stage = jax.lax.axis_index(STAGE_AXIS)
         is_last = stage == n_stages - 1
         tok = tokens.reshape(M, mb, seq)
@@ -370,7 +393,7 @@ def build_pp_lm_train_step(
                     dy_in.astype(model.dtype),
                     jnp.where(bo, jnp.float32(1.0), jnp.float32(0.0)),
                 ),
-                (DATA_AXIS, STAGE_AXIS),
+                loss_axes,
             )
             dp, dx = vjp_fn(cts)
             gacc = jax.tree.map(jnp.add, gacc, dp)
@@ -401,10 +424,10 @@ def build_pp_lm_train_step(
                     jnp.zeros(act, model.dtype),
                     jnp.zeros(act, model.dtype),
                 ),
-                (DATA_AXIS, STAGE_AXIS),
+                loss_axes,
             ),
             gacc0,
-            mark_varying(jnp.float32(0.0), (DATA_AXIS, STAGE_AXIS)),
+            mark_varying(jnp.float32(0.0), loss_axes),
         )
         (_, _, _, gacc, loss_sum), _ = jax.lax.scan(tick, carry0, sched)
 
@@ -413,7 +436,7 @@ def build_pp_lm_train_step(
         # data, shared over data AND stage — see the seed-masking comment),
         # so gacc IS the fully-reduced gradient after the scan
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
-        loss = jax.lax.psum(loss_sum, (DATA_AXIS, STAGE_AXIS))
+        loss = jax.lax.psum(loss_sum, loss_axes)
         lr = lr_fn(opt_state.step)
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
         return new_params, new_opt, loss
@@ -423,7 +446,7 @@ def build_pp_lm_train_step(
     def compile_for(state: TrainState):
         param_spec = pp_param_specs(state.params)
         opt_spec = _opt_specs(state, param_spec)
-        tok_spec = P(DATA_AXIS, None)
+        tok_spec = P(DATA_AXIS, seq_axis) if seq_axis else P(DATA_AXIS, None)
         # PP x TP: leave the 'model' axis to the GSPMD partitioner (manual
         # over data/stage only) — Megatron splits inside each stage, from
         # the sharded params' own NamedShardings
@@ -461,7 +484,7 @@ def _opt_specs(state: TrainState, param_spec):
     return mirror_opt_fields(state.opt_state, state.params, param_spec, P())
 
 
-def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
+def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int, seq_axis=None):
     """Compile the DP x PP LM validation step.
 
     Same replicated ``(loss, acc1, acc5)`` contract as every other eval step
@@ -472,8 +495,10 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
 
     n_stages = mesh.shape[STAGE_AXIS]
     n_data = mesh.shape[DATA_AXIS]
+    n_seq = mesh.shape[seq_axis] if seq_axis else 1
+    red_axes = (DATA_AXIS, STAGE_AXIS) + ((seq_axis,) if seq_axis else ())
     M_cfg = int(num_microbatches)
-    embed, apply_blocks, apply_head = _stage_applies(model)
+    embed, apply_blocks, apply_head = _stage_applies(model, seq_axis)
 
     def body(params, tokens, labels):
         b_local, seq = tokens.shape
@@ -496,7 +521,13 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
             )
         feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
         mb = b_local // M
-        global_tokens = b_local * seq * n_data
+        if seq * n_seq > model.max_len:
+            # same guard as the train bodies: beyond the table,
+            # dynamic_slice would CLAMP and silently reuse position rows
+            raise ValueError(
+                f"global sequence {seq * n_seq} exceeds max_len {model.max_len}"
+            )
+        global_tokens = b_local * seq * n_data * n_seq
         stage = jax.lax.axis_index(STAGE_AXIS)
         tok = tokens.reshape(M, mb, seq)
         lab = labels.reshape(M, mb, seq)
@@ -525,12 +556,12 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
         carry0 = mark_varying(
             (jnp.zeros((mb, seq, model.embed_dim), model.dtype),
              jnp.float32(0.0), jnp.int32(0), jnp.int32(0)),
-            (DATA_AXIS, STAGE_AXIS),
+            red_axes,
         )
         (_, loss_sum, c1, c5), _ = jax.lax.scan(
             tick, carry0, (feed_idx, emit_idx, emit_valid)
         )
-        axes = (DATA_AXIS, STAGE_AXIS)
+        axes = red_axes
         loss = jax.lax.psum(loss_sum, axes)
         total = jnp.float32(global_tokens)
         acc1 = jax.lax.psum(c1, axes).astype(jnp.float32) / total * 100.0
@@ -539,7 +570,7 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int):
 
     def compile_for(state: TrainState):
         param_spec = pp_param_specs(state.params)
-        tok_spec = P(DATA_AXIS, None)
+        tok_spec = P(DATA_AXIS, seq_axis) if seq_axis else P(DATA_AXIS, None)
         manual = {}
         if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
             manual = dict(axis_names=frozenset({DATA_AXIS, STAGE_AXIS}))

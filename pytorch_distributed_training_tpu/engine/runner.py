@@ -207,14 +207,14 @@ class Runner:
                 "training.sequence_parallelism / tensor_parallelism / "
                 "pipeline_parallelism require model.name: TransformerLM"
             )
-        if self.pipe_par > 1 and self.seq_par > 1:
-            # PP's per-tick ppermute moves whole-microbatch activations; the
-            # ring-attention path would need a second in-tick collective
-            # schedule over the sequence axis — not wired (PP x TP is)
+        if self.pipe_par > 1 and self.seq_par > 1 and self.tensor_par > 1:
+            # the pipeline mesh supports ONE inner axis besides stage:
+            # model (PP x TP) or sequence (PP x SP) — a 4-axis composition
+            # is not wired (parallel/pipeline.make_pp_mesh)
             raise ValueError(
-                "pipeline_parallelism does not compose with "
-                "sequence_parallelism yet (pipeline_parallelism x "
-                "tensor_parallelism is supported)"
+                "pipeline_parallelism x sequence_parallelism x "
+                "tensor_parallelism (three-way) is not wired; pick "
+                "PP x SP or PP x TP"
             )
         # Additive key ``training.pp_schedule``: microbatch schedule for the
         # pipeline step — "gpipe" (autodiff backward, O(M) activation
@@ -303,12 +303,15 @@ class Runner:
             if (
                 self.seq_par > 1
                 and self.tensor_par == 1
+                and self.pipe_par == 1
                 and not self.zero
                 and not self.is_moe
             ):
                 # ring-attention path only; the GSPMD path (tensor_par or
                 # zero or MoE) keeps seq_axis=None and lets the partitioner
-                # distribute — a seq_axis model requires shard_map
+                # distribute, and the PP x SP path builds its own
+                # seq_axis'd stage blocks (pp_steps._stage_applies) — a
+                # seq_axis model requires shard_map
                 model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
             self.model = get_model(
                 model_name,
@@ -545,7 +548,10 @@ class Runner:
                     f"divisible by training.tensor_parallelism "
                     f"({self.tensor_par})"
                 )
-            self.mesh = make_pp_mesh(self.pipe_par, self.tensor_par)
+            self.mesh = make_pp_mesh(
+                self.pipe_par, self.tensor_par, self.seq_par
+            )
+            pp_seq_axis = SEQUENCE_AXIS if self.seq_par > 1 else None
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
             pp_params = pp_stack_params(params, self.model.depth)
@@ -560,11 +566,15 @@ class Runner:
                 num_microbatches=self.microbatches,
                 label_smoothing=self.label_smoothing,
                 schedule=self.pp_schedule,
+                seq_axis=pp_seq_axis,
             )(self.state)
             self.eval_step = build_pp_lm_eval_step(
-                self.model, self.mesh, self.microbatches
+                self.model, self.mesh, self.microbatches,
+                seq_axis=pp_seq_axis,
             )(self.state)
-            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, None))
+            tok_sharding = NamedSharding(
+                self.mesh, P(DATA_AXIS, pp_seq_axis)
+            )
             self._img_sharding = tok_sharding
             self._label_sharding = tok_sharding
         elif self.is_lm and (self.tensor_par > 1 or self.zero or self.is_moe):

@@ -30,7 +30,11 @@ from typing import Any, Dict, Mapping, Tuple
 
 import numpy as np
 
-__all__ = ["import_torch_resnet_state_dict", "load_torchvision_checkpoint"]
+__all__ = [
+    "import_torch_resnet_state_dict",
+    "import_torch_lm_state_dict",
+    "load_torchvision_checkpoint",
+]
 
 
 def _to_numpy(t) -> np.ndarray:
@@ -146,3 +150,78 @@ def load_torchvision_checkpoint(path: str, variables: Mapping) -> Dict:
     if "state_dict" in state_dict:  # training-harness checkpoints nest it
         state_dict = state_dict["state_dict"]
     return import_torch_resnet_state_dict(variables, state_dict)
+
+
+def _torch_lm_key(path: Tuple[str, ...]) -> Tuple[str, str]:
+    """Map a Flax TransformerLM params path to (torch key, transform).
+
+    Torch-twin naming contract (tests/test_torch_port_lm.py):
+    ``tok_emb.weight``, ``pos_emb``, ``blocks.{i}.{ln1,ln2}.{weight,bias}``,
+    ``blocks.{i}.{attn_qkv,attn_proj}.{weight,bias}`` (Linear layers using
+    the SAME heads-major (H, 3, head_dim) flat-output layout as
+    ops/attention.py), ``blocks.{i}.{fc1,fc2}.{weight,bias}``,
+    ``ln_f.{weight,bias}``, ``head.{weight,bias}``.
+    """
+    collection, *mods, leaf = path
+    assert collection == "params", path
+    if not mods:
+        if leaf == "tok_embedding":
+            return "tok_emb.weight", "none"
+        if leaf == "pos_embedding":
+            return "pos_emb", "none"
+        raise KeyError(f"unmapped Flax leaf {path}")
+    if mods[0].startswith("block") and mods[0] != "blocks":
+        i = mods[0][len("block"):]
+        sub = mods[1]
+        if sub in ("ln1", "ln2"):
+            return (
+                f"blocks.{i}.{sub}.{'weight' if leaf == 'scale' else 'bias'}",
+                "none",
+            )
+        if sub == "attn":
+            name = {"qkv": "attn_qkv", "proj": "attn_proj"}[mods[2]]
+            return (
+                f"blocks.{i}.{name}.{leaf.replace('kernel', 'weight')}",
+                "linear" if leaf == "kernel" else "none",
+            )
+        if sub == "mlp":
+            return (
+                f"blocks.{i}.{mods[2]}.{leaf.replace('kernel', 'weight')}",
+                "linear" if leaf == "kernel" else "none",
+            )
+        raise KeyError(f"unmapped Flax leaf {path}")
+    if mods[0] == "ln":
+        return f"ln_f.{'weight' if leaf == 'scale' else 'bias'}", "none"
+    if mods[0] == "head":
+        return (
+            f"head.{leaf.replace('kernel', 'weight')}",
+            "linear" if leaf == "kernel" else "none",
+        )
+    raise KeyError(f"unmapped Flax leaf {path}")
+
+
+def import_torch_lm_state_dict(params: Mapping, state_dict: Mapping) -> Dict:
+    """Convert a torch decoder-LM ``state_dict`` (twin naming above) into a
+    Flax :class:`~..models.transformer_lm.TransformerLM` params tree.
+    Strict: missing / unconsumed / shape-mismatched tensors raise."""
+    flat = _flatten({"params": dict(params)})
+    consumed = set()
+    new_flat: Dict[Tuple[str, ...], Any] = {}
+    for path, leaf in flat.items():
+        key, transform = _torch_lm_key(path)
+        if key not in state_dict:
+            raise KeyError(f"torch state_dict missing '{key}' (for Flax {path})")
+        arr = _to_numpy(state_dict[key])
+        if transform == "linear":
+            arr = arr.T  # (out, in) -> (in, out)
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch for {key}: torch {arr.shape} vs Flax "
+                f"{np.shape(leaf)} at {path}"
+            )
+        new_flat[path] = arr.astype(np.asarray(leaf).dtype)
+        consumed.add(key)
+    extra = set(state_dict) - consumed
+    if extra:
+        raise KeyError(f"torch state_dict keys not consumed: {sorted(extra)}")
+    return _unflatten(new_flat)["params"]

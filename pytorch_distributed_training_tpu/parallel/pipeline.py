@@ -58,21 +58,36 @@ STAGE_AXIS = "stage"
 def make_pp_mesh(
     pipeline_parallelism: int,
     tensor_parallelism: int = 1,
+    sequence_parallelism: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """``(data, stage)`` mesh — or ``(data, stage, model)`` when
-    ``tensor_parallelism > 1`` (PP x TP: Megatron splits inside each
-    pipeline stage; engine/pp_steps runs shard_map-manual over data/stage
-    and leaves ``model`` to the GSPMD partitioner).  ``mesh_utils``
-    ordering keeps successive stages ICI-adjacent so the per-tick
-    activation ``ppermute`` is a nearest-neighbor hop, and the model axis
-    innermost so the per-matmul TP all-reduces ride the fastest links."""
+    """``(data, stage)`` mesh — growing a ``model`` axis for PP x TP
+    (Megatron splits inside each pipeline stage; engine/pp_steps runs
+    shard_map-manual over data/stage and leaves ``model`` to the GSPMD
+    partitioner) or a ``sequence`` axis for PP x SP (ring attention inside
+    each stage over sequence shards).  ``mesh_utils`` ordering keeps
+    successive stages ICI-adjacent so the per-tick activation ``ppermute``
+    is a nearest-neighbor hop; the model/sequence axis sits innermost so
+    the much-more-frequent per-matmul all-reduces (TP) or per-layer ring
+    hops (SP) ride the fastest links."""
     from .mesh import MODEL_AXIS
+    from .sequence import SEQUENCE_AXIS
 
+    if tensor_parallelism > 1 and sequence_parallelism > 1:
+        raise ValueError(
+            "pipeline x tensor x sequence (3 inner axes) is not wired; "
+            "pick PP x TP or PP x SP"
+        )
     if tensor_parallelism > 1:
         return _make_nd_mesh(
             (pipeline_parallelism, tensor_parallelism),
             (STAGE_AXIS, MODEL_AXIS),
+            devices,
+        )
+    if sequence_parallelism > 1:
+        return _make_nd_mesh(
+            (pipeline_parallelism, sequence_parallelism),
+            (STAGE_AXIS, SEQUENCE_AXIS),
             devices,
         )
     return _make_nd_mesh((pipeline_parallelism,), (STAGE_AXIS,), devices)

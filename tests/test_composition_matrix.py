@@ -91,6 +91,10 @@ SUPPORTED = [
                      microbatches=4)),
     ("pp2xtp2-1f1b", _cfg(pipeline_parallelism=2, tensor_parallelism=2,
                           microbatches=4, pp_schedule="1f1b")),
+    ("pp2xsp2", _cfg(pipeline_parallelism=2, sequence_parallelism=2,
+                     microbatches=4)),
+    ("pp2xsp2-1f1b", _cfg(pipeline_parallelism=2, sequence_parallelism=2,
+                          microbatches=4, pp_schedule="1f1b")),
     ("zero", _cfg(zero=True)),
     ("zeroxtp2", _cfg(zero=True, tensor_parallelism=2)),
     ("zeroxsp2", _cfg(zero=True, sequence_parallelism=2)),
@@ -103,8 +107,9 @@ SUPPORTED = [
 
 # (id, cfg, error-message fragment) — combinations that MUST raise.
 UNSUPPORTED = [
-    ("ppxsp", _cfg(pipeline_parallelism=2, sequence_parallelism=2),
-     "does not compose with sequence_parallelism"),
+    ("ppxspxtp", _cfg(pipeline_parallelism=2, sequence_parallelism=2,
+                      tensor_parallelism=2),
+     "three-way"),
     ("ppxmoe", _cfg(model_extra={"moe_experts": 4}, pipeline_parallelism=2),
      "moe_experts does not compose with pipeline_parallelism"),
     ("ppxzero", _cfg(pipeline_parallelism=2, zero=True),

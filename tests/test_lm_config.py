@@ -479,10 +479,12 @@ def test_pipeline_parallelism_validation():
     cfg["training"]["pipeline_parallelism"] = 4
     with pytest.raises(ValueError, match="depth"):
         _run(cfg)
-    # PP does not compose with SP/TP yet
+    # PP x SP and PP x TP compose (round 3) but the three-way does not —
+    # the pipeline mesh carries ONE inner axis besides stage
     cfg = _lm_cfg(2, dict(base))
     cfg["training"]["pipeline_parallelism"] = 2
-    with pytest.raises(ValueError, match="compose"):
+    cfg["training"]["tensor_parallelism"] = 2
+    with pytest.raises(ValueError, match="three-way"):
         _run(cfg)
     # microbatches below the stage count would deadlock the schedule
     cfg = _lm_cfg(1, dict(base))
