@@ -104,8 +104,13 @@ class Checkpointer:
             # capacity.  Anything else falls through to the descriptive
             # error.
             converted = self._restore_converting_layout(step, state, logger)
-            if converted is not None:
+            if converted is not None and not isinstance(converted, Exception):
                 return converted, step + 1
+            convert_err = (
+                f" The converting restore itself failed with: {converted!r}."
+                if isinstance(converted, Exception)
+                else ""
+            )
 
             def _layout(tree):
                 try:
@@ -119,15 +124,24 @@ class Checkpointer:
             raise RuntimeError(
                 f"checkpoint at {self.directory} (iter {step}) does not match "
                 f"the run's state layout [{_layout(state)}] and automatic "
-                "PP<->per-layer conversion did not apply. If the checkpoint "
-                "was written under a different training setting, convert it "
-                "with parallel.pipeline.pp_stack_params / pp_unstack_params "
-                "before resuming, or resume with the original setting. "
-                f"Underlying error: {e}"
+                f"PP<->per-layer conversion did not apply.{convert_err} If "
+                "the checkpoint was written under a different training "
+                "setting, convert it with parallel.pipeline.pp_stack_params "
+                "/ pp_unstack_params before resuming, or resume with the "
+                f"original setting. Underlying error: {e}"
             ) from e
         if logger:
             logger.info("Restored checkpoint at iter %d from %s", step, self.directory)
         return restored, step + 1
+
+    @staticmethod
+    def _path_keys(tree) -> set:
+        """Set of stringified key paths of ``tree``'s leaves (one shared
+        normalization so the two sides of the comparison cannot drift)."""
+        return {
+            tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
+            for p, _ in jax.tree_util.tree_flatten_with_path(tree)[0]
+        }
 
     def _structure_differs(self, step, state) -> bool:
         """Whether the checkpoint's SAVED pytree structure differs from the
@@ -136,15 +150,7 @@ class Checkpointer:
         'no structural evidence' (False): the restore error re-raises."""
         try:
             meta = self._manager.item_metadata(step)
-            saved_paths = {
-                tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
-                for p, _ in jax.tree_util.tree_flatten_with_path(meta)[0]
-            }
-            want_paths = {
-                tuple(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
-                for p, _ in jax.tree_util.tree_flatten_with_path(state)[0]
-            }
-            return saved_paths != want_paths
+            return self._path_keys(meta) != self._path_keys(state)
         except Exception:
             return False
 
@@ -154,8 +160,10 @@ class Checkpointer:
         per-layer ``{block0..blockN, ...}``) and convert it into
         ``state``'s layout — params AND every optimizer-moment tree that
         mirrors them (SGD momentum, AdamW mu/nu).  Returns the converted
-        state, or ``None`` when the mismatch is not this relayout (caller
-        falls through to the descriptive error)."""
+        state; ``None`` when the target isn't in either known layout; or
+        the inner ``Exception`` when the converting restore itself failed
+        (the caller surfaces it — swallowing it would misdiagnose
+        corruption as a layout problem)."""
         import orbax.checkpoint as ocp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -258,8 +266,12 @@ class Checkpointer:
             restored = self._manager.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
-        except Exception:
-            return None  # not the PP relayout — let the caller explain
+        except Exception as inner:
+            # NOT silently swallowed: the caller's final error must carry
+            # this (the structure differed, so the converting restore was
+            # the right attempt — if IT failed on an IO/corruption error,
+            # pointing the operator at pipeline settings would misdiagnose)
+            return inner
         new_opt = {}
         for name in opt._fields:
             field = getattr(restored.opt_state, name)

@@ -214,6 +214,7 @@ def build_pp_lm_train_step(
     label_smoothing: float = 0.0,
     schedule: str = "gpipe",
     seq_axis=None,
+    zero: bool = False,
 ):
     """Compile one DP x PP (optionally x TP) LM iteration.
 
@@ -258,7 +259,7 @@ def build_pp_lm_train_step(
     embed, apply_blocks, apply_head = _stage_applies(model, seq_axis)
     feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
 
-    def body(params, opt_state, tokens, labels):
+    def grads_gpipe(params, tokens, labels):
         b_local, seq = tokens.shape
         if b_local % M != 0:
             raise ValueError(
@@ -310,11 +311,9 @@ def build_pp_lm_train_step(
             return jax.lax.psum(loss_sum, loss_axes)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        lr = lr_fn(opt_state.step)
-        new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
-        return new_params, new_opt, loss
+        return grads, loss
 
-    def body_1f1b(params, opt_state, tokens, labels):
+    def grads_1f1b(params, tokens, labels):
         b_local, seq = tokens.shape
         if b_local % M != 0:
             raise ValueError(
@@ -437,11 +436,15 @@ def build_pp_lm_train_step(
         # so gacc IS the fully-reduced gradient after the scan
         grads = jax.tree.map(lambda g, p: g.astype(p.dtype), gacc, params)
         loss = jax.lax.psum(loss_sum, loss_axes)
+        return grads, loss
+
+    grads_fn = grads_gpipe if schedule == "gpipe" else grads_1f1b
+
+    def step_body(params, opt_state, tokens, labels):
+        grads, loss = grads_fn(params, tokens, labels)
         lr = lr_fn(opt_state.step)
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
         return new_params, new_opt, loss
-
-    step_body = body if schedule == "gpipe" else body_1f1b
 
     def compile_for(state: TrainState):
         param_spec = pp_param_specs(state.params)
@@ -453,6 +456,43 @@ def build_pp_lm_train_step(
         manual = {}
         if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
             manual = dict(axis_names=frozenset({DATA_AXIS, STAGE_AXIS}))
+        if zero:
+            # ZeRO-1 x PP: only the GRADIENT computation runs in the
+            # manual shard_map (data-sharded moments must not enter it —
+            # the manual in_specs would gather them, defeating the
+            # sharding).  The elementwise update runs outside under GSPMD:
+            # the data-sharded moment shardings (pp_state_shardings
+            # zero=True) make the partitioner reduce-scatter the grads
+            # into the moment update and gather the fresh stage-sharded
+            # params — the same construction as the GSPMD TP ZeRO path.
+            sharded_grads = jax.shard_map(
+                grads_fn,
+                mesh=mesh,
+                in_specs=(param_spec, tok_spec, tok_spec),
+                out_specs=(param_spec, P()),
+                **manual,
+            )
+            param_sh = jax.tree.map(lambda x: x.sharding, state.params)
+
+            def step(state: TrainState, tokens, labels):
+                grads, loss = sharded_grads(state.params, tokens, labels)
+                lr = lr_fn(state.opt_state.step)
+                new_params, new_opt = optimizer.update(
+                    grads, state.opt_state, state.params, lr
+                )
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, param_sh
+                )
+                return (
+                    TrainState(
+                        params=new_params, batch_stats=state.batch_stats,
+                        opt_state=new_opt, ema=state.ema,
+                    ),
+                    loss,
+                )
+
+            return jax.jit(step, donate_argnums=(0,) if donate else ())
+
         sharded = jax.shard_map(
             step_body,
             mesh=mesh,

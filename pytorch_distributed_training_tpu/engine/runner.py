@@ -261,12 +261,9 @@ class Runner:
             raise ValueError(
                 "training.zero is only wired for the LM task (GSPMD path)"
             )
-        if self.zero and self.pipe_par > 1:
-            # the PP layout already stage-shards the moments; ZeRO's
-            # data-axis moment sharding is a different layout contract
-            raise ValueError(
-                "training.zero does not compose with pipeline_parallelism"
-            )
+        # (round 3) training.zero composes with pipeline_parallelism: the
+        # PP step computes grads in its shard_map and runs the update
+        # outside under GSPMD with data-sharded moments (engine/pp_steps)
         if self.is_lm:
             for key, par in (
                 ("sequence_parallelism", self.seq_par),
@@ -560,13 +557,16 @@ class Runner:
                 batch_stats={},
                 opt_state=self.optimizer.init(pp_params),
             )
-            self.state = jax.device_put(state, pp_state_shardings(state, self.mesh))
+            self.state = jax.device_put(
+                state, pp_state_shardings(state, self.mesh, zero=self.zero)
+            )
             self.train_step = build_pp_lm_train_step(
                 self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
                 num_microbatches=self.microbatches,
                 label_smoothing=self.label_smoothing,
                 schedule=self.pp_schedule,
                 seq_axis=pp_seq_axis,
+                zero=self.zero,
             )(self.state)
             self.eval_step = build_pp_lm_eval_step(
                 self.model, self.mesh, self.microbatches,

@@ -137,14 +137,23 @@ def pp_param_specs(pp_params, tensor_parallel: bool = False):
     }
 
 
-def pp_state_shardings(state, mesh: Mesh):
+def pp_state_shardings(state, mesh: Mesh, zero: bool = False):
     """Shardings for a pipeline ``TrainState``: optimizer moment trees that
     mirror the params structure take the params' specs (stage-sharded
-    moments for stage-sharded layers), scalar fields stay replicated."""
+    moments for stage-sharded layers), scalar fields stay replicated.
+
+    ``zero``: ZeRO-1 — moments are ADDITIONALLY sharded over the ``data``
+    axis on their first free divisible dim (``tensor.zero_shard_moment``,
+    the same rule as the GSPMD path), cutting per-device optimizer memory
+    by the data-axis size.  The pipeline step then computes the update
+    OUTSIDE its shard_map so the GSPMD partitioner reduce-scatters the
+    gradients into the sharded moment update and gathers fresh params
+    (engine/pp_steps, ``zero=True``)."""
     from ..engine.steps import TrainState  # avoid import cycle at module load
 
     assert isinstance(state, TrainState)
-    from .mesh import MODEL_AXIS
+    from .mesh import DATA_AXIS, MODEL_AXIS
+    from .tensor import zero_shard_moment
 
     rep = NamedSharding(mesh, P())
     tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
@@ -155,6 +164,15 @@ def pp_state_shardings(state, mesh: Mesh):
         pp_param_specs(state.params, tensor_parallel=tp),
         is_leaf=lambda x: isinstance(x, P),
     )
-    opt_sh = mirror_opt_fields(state.opt_state, state.params, param_sh, rep)
+    moment_sh = (
+        jax.tree.map(
+            lambda sh, leaf: zero_shard_moment(sh, leaf, mesh),
+            param_sh,
+            state.params,
+        )
+        if zero and mesh.shape[DATA_AXIS] > 1
+        else param_sh
+    )
+    opt_sh = mirror_opt_fields(state.opt_state, state.params, moment_sh, rep)
     bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
     return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)

@@ -59,6 +59,26 @@ def mirror_opt_fields(opt_state, params, param_tree, rep):
     return type(opt_state)(**fields)
 
 
+def zero_shard_moment(sh: NamedSharding, leaf, mesh: Mesh) -> NamedSharding:
+    """ZeRO-1 moment sharding rule: ADDITIONALLY shard the first FREE
+    dimension (spec None + divisible by the data-axis size) over ``data``.
+    For column-parallel kernels that is dim 0; for row-parallel kernels
+    (``P(model, None)``) dim 0 carries the model axis, so dim 1 takes the
+    data sharding — without this, ~40% of per-block moment memory would
+    silently stay unsharded under ZeRO + TP.  Shared by the GSPMD TP path
+    (:func:`tp_state_shardings`) and the pipeline path
+    (``parallel.pipeline.pp_state_shardings``) so the rule cannot drift."""
+    from .mesh import DATA_AXIS
+
+    n_data = mesh.shape[DATA_AXIS]
+    spec = list(sh.spec) + [None] * (leaf.ndim - len(sh.spec))
+    for d in range(leaf.ndim):
+        if spec[d] is None and leaf.shape[d] % n_data == 0:
+            spec[d] = DATA_AXIS
+            return NamedSharding(mesh, P(*spec))
+    return sh
+
+
 def _spec_for(path) -> P:
     keys = [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
     leaf = keys[-1] if keys else ""
@@ -116,22 +136,12 @@ def tp_state_shardings(state, mesh: Mesh, zero: bool = False):
     param_sh = lm_tp_shardings(state.params, mesh)
     rep = NamedSharding(mesh, P())
     n_data = mesh.shape[DATA_AXIS]
-
-    def zero_shard(sh, leaf):
-        # shard the first FREE dimension (spec None + divisible): for
-        # column-parallel kernels that is dim 0; for row-parallel kernels
-        # (P(model, None)) dim 0 carries the model axis, so dim 1 takes the
-        # data sharding — without this, ~40% of per-block moment memory
-        # would silently stay unsharded under ZeRO + TP
-        spec = list(sh.spec) + [None] * (leaf.ndim - len(sh.spec))
-        for d in range(leaf.ndim):
-            if spec[d] is None and leaf.shape[d] % n_data == 0:
-                spec[d] = DATA_AXIS
-                return NamedSharding(mesh, P(*spec))
-        return sh
-
     moment_sh = (
-        jax.tree.map(zero_shard, param_sh, state.params)
+        jax.tree.map(
+            lambda sh, leaf: zero_shard_moment(sh, leaf, mesh),
+            param_sh,
+            state.params,
+        )
         if zero and n_data > 1
         else param_sh
     )

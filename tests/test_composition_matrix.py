@@ -96,6 +96,11 @@ SUPPORTED = [
     ("pp2xsp2-1f1b", _cfg(pipeline_parallelism=2, sequence_parallelism=2,
                           microbatches=4, pp_schedule="1f1b")),
     ("zero", _cfg(zero=True)),
+    ("zeroxpp2", _cfg(zero=True, pipeline_parallelism=2, microbatches=4)),
+    ("zeroxpp2xtp2", _cfg(zero=True, pipeline_parallelism=2,
+                          tensor_parallelism=2, microbatches=4)),
+    ("zeroxpp2xsp2", _cfg(zero=True, pipeline_parallelism=2,
+                          sequence_parallelism=2, microbatches=4)),
     ("zeroxtp2", _cfg(zero=True, tensor_parallelism=2)),
     ("zeroxsp2", _cfg(zero=True, sequence_parallelism=2)),
     ("moe-ep4", _cfg(model_extra={"moe_experts": 4}, tensor_parallelism=4)),
@@ -112,8 +117,6 @@ UNSUPPORTED = [
      "three-way"),
     ("ppxmoe", _cfg(model_extra={"moe_experts": 4}, pipeline_parallelism=2),
      "moe_experts does not compose with pipeline_parallelism"),
-    ("ppxzero", _cfg(pipeline_parallelism=2, zero=True),
-     "zero does not compose with pipeline_parallelism"),
     ("ppxgrad-accum", _cfg(pipeline_parallelism=2, grad_accumulation=2),
      "grad_accumulation is redundant under pipeline_parallelism"),
     ("micro-no-pp", _cfg(microbatches=4),

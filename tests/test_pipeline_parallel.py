@@ -402,3 +402,73 @@ def test_pp_sp_eval_step():
     ref = cross_entropy_loss(logits.reshape(-1, VOCAB), labels.reshape(-1))
     np.testing.assert_allclose(loss, float(ref), atol=1e-5)
     assert 0.0 <= acc1 <= acc5 <= 100.0
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_zero_step_matches_plain(schedule):
+    """ZeRO-1 x PP: moments shard over (stage, data); the grads come out of
+    the manual shard_map and the update runs outside under GSPMD (the
+    data-sharded moment shardings make the partitioner reduce-scatter the
+    grads and gather the fresh params).  Identical math to the plain PP
+    step — loss and updated params equal the single-device oracle — and
+    the moment shardings must SURVIVE the step (a silent gather would
+    defeat the memory saving)."""
+    model = _model()
+    tokens, labels = _data(seed=17)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    loss_ref, params_ref = _oracle(model, params, opt, tokens, labels, 0.05)
+
+    mesh = make_pp_mesh(2)  # data 4 x stage 2
+    pp_params = pp_stack_params(params, DEPTH)
+    state = TrainState(
+        params=pp_params, batch_stats={}, opt_state=opt.init(pp_params)
+    )
+    state = jax.device_put(state, pp_state_shardings(state, mesh, zero=True))
+    mom = state.opt_state.momentum["blocks"]["attn"]["qkv"]["kernel"]
+    assert "data" in mom.sharding.spec, mom.sharding.spec
+
+    step = build_pp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, num_microbatches=4,
+        donate=False, schedule=schedule, zero=True,
+    )(state)
+    state2, loss_pp = step(state, tokens, labels)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), atol=1e-5)
+    up = pp_unstack_params(jax.device_get(state2.params), DEPTH)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(up)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+    mom2 = state2.opt_state.momentum["blocks"]["attn"]["qkv"]["kernel"]
+    assert "data" in mom2.sharding.spec, mom2.sharding.spec
+
+
+def test_pp_zero_tp_step_matches_single_device():
+    """ZeRO x PP x TP three-way: grads from the partial-manual shard_map
+    (model axis auto), update outside under GSPMD with (stage, data)- and
+    model-sharded moments — must still equal the single-device oracle and
+    keep the moment shardings."""
+    model = _model()
+    tokens, labels = _data(seed=19)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    loss_ref, params_ref = _oracle(model, params, opt, tokens, labels, 0.05)
+
+    mesh = make_pp_mesh(2, tensor_parallelism=2)  # data2 x stage2 x model2
+    pp_params = pp_stack_params(params, DEPTH)
+    state = TrainState(
+        params=pp_params, batch_stats={}, opt_state=opt.init(pp_params)
+    )
+    state = jax.device_put(state, pp_state_shardings(state, mesh, zero=True))
+    mom = state.opt_state.momentum["blocks"]["attn"]["qkv"]["kernel"]
+    assert "data" in mom.sharding.spec and "model" in mom.sharding.spec
+
+    step = build_pp_lm_train_step(
+        model, opt, lambda _: jnp.float32(0.05), mesh, num_microbatches=4,
+        donate=False, schedule="1f1b", zero=True,
+    )(state)
+    state2, loss_pp = step(state, tokens, labels)
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), atol=1e-5)
+    up = pp_unstack_params(jax.device_get(state2.params), DEPTH)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(up)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
+    mom2 = state2.opt_state.momentum["blocks"]["attn"]["qkv"]["kernel"]
+    assert "data" in mom2.sharding.spec, mom2.sharding.spec
