@@ -64,7 +64,7 @@ from ..parallel import (
 )
 from ..parallel.sequence import SEQUENCE_AXIS
 from ..schedulers import get_scheduler
-from ..utils import make_deterministic, make_iter_dataloader
+from ..utils import enable_compile_cache, make_deterministic, make_iter_dataloader
 from .checkpoint import Checkpointer
 from .profiling import TraceProfiler
 from .sp_steps import build_lm_eval_step, build_lm_train_step
@@ -146,6 +146,16 @@ class Runner:
 
         cfg = self.global_cfg
         train_cfg = cfg["training"]
+
+        # Additive key ``training.compile_cache``: persistent XLA compilation
+        # cache directory — the autotune analog of the reference's
+        # ``cudnn.benchmark`` (train_distributed.py:54, SURVEY §2.3).  Set
+        # BEFORE any step is built so the first jit of this process can
+        # already hit a previous launch's entry.
+        compile_cache = train_cfg.get("compile_cache")
+        if compile_cache:
+            path = enable_compile_cache(str(compile_cache))
+            self.logger.info("Persistent XLA compilation cache at %s", path)
 
         ds_kwargs = dict(
             n_classes=cfg["dataset"]["n_classes"],

@@ -1,0 +1,100 @@
+"""Persistent XLA compilation cache (training.compile_cache).
+
+The TPU-native analog of the reference's ``cudnn.benchmark = True``
+(train_distributed.py:54; SURVEY.md §2.3 "cuDNN autotune" row): amortize
+program compilation across launches via JAX's persistent cache.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_training_tpu.utils import enable_compile_cache
+
+
+@pytest.fixture
+def _restore_cache_config():
+    saved = {
+        name: getattr(jax.config, name)
+        for name in (
+            "jax_compilation_cache_dir",
+            "jax_persistent_cache_min_compile_time_secs",
+            "jax_persistent_cache_min_entry_size_bytes",
+        )
+    }
+    yield
+    for name, value in saved.items():
+        jax.config.update(name, value)
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()  # drop the initialized cache object too
+    except Exception:
+        pass
+
+
+def test_enable_compile_cache_writes_entries(tmp_path, _restore_cache_config):
+    cache_dir = tmp_path / "xla-cache"
+    returned = enable_compile_cache(str(cache_dir))
+    assert returned == str(cache_dir)
+    assert cache_dir.is_dir()
+
+    # A program this process has never compiled: its executable must land in
+    # the cache directory (thresholds are zeroed by enable_compile_cache, so
+    # even a trivial compile is persisted).
+    @jax.jit
+    def f(x):
+        return jnp.sin(x) * 41.25 + jnp.cos(x) ** 3
+
+    f(jnp.arange(7.0)).block_until_ready()
+    entries = list(cache_dir.iterdir())
+    assert entries, "no cache entries written"
+
+
+def test_runner_config_key_wires_cache(tmp_path, _restore_cache_config):
+    """training.compile_cache: the Runner enables the cache before building
+    its compiled steps, so a config-driven run populates the directory."""
+    from pytorch_distributed_training_tpu.engine import Runner
+
+    cache_dir = tmp_path / "run-cache"
+    cfg = {
+        "dataset": {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": 4,
+            "image_size": 32,
+            "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {
+                "name": "SGD", "lr": 0.05, "weight_decay": 1.0e-4, "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [4], "gamma": 0.1},
+            "train_iters": 2,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": False,
+            "compile_cache": str(cache_dir),
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18"},
+    }
+    runner = Runner(
+        num_nodes=1,
+        rank=0,
+        seed=7,
+        dist_url="tcp://127.0.0.1:9907",
+        dist_backend="tpu",
+        multiprocessing=False,
+        logger_queue=None,
+        global_cfg=cfg,
+        tb_writer_constructor=lambda: None,
+    )
+    runner()
+    assert runner.iter == 2
+    assert cache_dir.is_dir()
+    assert any(cache_dir.iterdir()), "Runner did not populate the compile cache"
+    assert jax.config.jax_compilation_cache_dir == str(cache_dir)
