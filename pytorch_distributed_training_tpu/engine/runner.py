@@ -179,6 +179,15 @@ class Runner:
         # (additive — e.g. embed_dim/depth/num_heads for TransformerLM).
         model_cfg = dict(cfg["model"])
         model_name = model_cfg.pop("name")
+        self.model_name = model_name
+        # Additive key ``model.pretrained``: initialize the run from a torch
+        # ``state_dict`` checkpoint (torchvision layout for the ResNet family,
+        # the twin layout of tests/test_torch_port_lm.py for TransformerLM) —
+        # the user-facing form of the reference's TORCH_HOME model-zoo
+        # weights (/root/reference/train.sh:2).  Ported via models/torch_port
+        # at state construction below; strict shape/name checking raises
+        # descriptive errors instead of silently part-loading.
+        self.pretrained = model_cfg.pop("pretrained", None)
         # The long-context LM task (beyond the reference, SURVEY.md §5.7):
         # first-class from the config surface — ``model.name:
         # TransformerLM`` + an LM dataset + optional
@@ -191,6 +200,13 @@ class Runner:
         # tensor_parallelism the stacked expert weights shard over the
         # model axis (expert parallelism).
         self.is_moe = self.is_lm and int(model_cfg.get("moe_experts", 0) or 0) > 0
+        if self.pretrained and self.is_moe:
+            # the torch-twin LM layout has no expert tensors — a part-load
+            # would silently leave experts at random init
+            raise ValueError(
+                "model.pretrained does not support MoE models "
+                "(no torch-twin layout for expert weights)"
+            )
         sync_bn = (
             bool(train_cfg["sync_bn"]) and self.distributed and not self.is_lm
         )
@@ -561,6 +577,8 @@ class Runner:
             pp_seq_axis = SEQUENCE_AXIS if self.seq_par > 1 else None
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            if self.pretrained:
+                params = self._apply_pretrained_lm(params)
             pp_params = pp_stack_params(params, self.model.depth)
             state = TrainState(
                 params=pp_params,
@@ -611,6 +629,8 @@ class Runner:
             self.mesh = make_3d_mesh(self.seq_par, self.tensor_par)
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            if self.pretrained:
+                params = self._apply_pretrained_lm(params)
             state = TrainState(
                 params=params,
                 batch_stats={},
@@ -638,6 +658,8 @@ class Runner:
             self.mesh = make_sp_mesh(self.seq_par)
             sample = jnp.zeros((1, self.seq_len), jnp.int32)
             params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
+            if self.pretrained:
+                params = self._apply_pretrained_lm(params)
             state = TrainState(
                 params=params,
                 batch_stats={},
@@ -661,6 +683,10 @@ class Runner:
             state = init_train_state(
                 self.model, self.optimizer, jax.random.PRNGKey(seed), sample
             )
+            if self.pretrained:
+                # before the EMA copy below, so the average starts from the
+                # pretrained weights too
+                state = self._apply_pretrained_image(state)
             if self.ema_decay is not None:
                 # EMA starts at the initial weights (standard convention).
                 # jnp.copy: ema must NOT alias the params buffers — the
@@ -762,6 +788,61 @@ class Runner:
             self.checkpointer.close()
         self.train_loader.close()
         self.val_loader.close()
+
+    # ------------------------------------------------- pretrained ingestion
+    def _load_torch_state_dict(self) -> dict:
+        """Read ``model.pretrained`` as a torch ``state_dict`` mapping."""
+        import os
+
+        path = self.pretrained
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"model.pretrained: checkpoint '{path}' does not exist"
+            )
+        import torch
+
+        state_dict = torch.load(path, map_location="cpu", weights_only=True)
+        if isinstance(state_dict, dict) and "state_dict" in state_dict:
+            state_dict = state_dict["state_dict"]  # harness checkpoints nest it
+        if not isinstance(state_dict, dict):
+            raise ValueError(
+                f"model.pretrained: '{path}' does not contain a state_dict "
+                f"mapping (got {type(state_dict).__name__})"
+            )
+        return state_dict
+
+    def _apply_pretrained_image(self, state: TrainState) -> TrainState:
+        """Replace params + BN stats with a ported torchvision checkpoint."""
+        from ..models.resnet import ResNet
+        from ..models.torch_port import import_torch_resnet_state_dict
+
+        if not isinstance(self.model, ResNet):
+            raise ValueError(
+                f"model.pretrained: only the ResNet family has a torchvision "
+                f"state_dict layout (got model.name: {self.model_name})"
+            )
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        loaded = import_torch_resnet_state_dict(
+            variables, self._load_torch_state_dict()
+        )
+        self.logger.info(
+            "Initialized %s from pretrained torch checkpoint %s",
+            self.model_name, self.pretrained,
+        )
+        return state.replace(
+            params=loaded["params"], batch_stats=loaded["batch_stats"]
+        )
+
+    def _apply_pretrained_lm(self, params):
+        """Replace LM params with a ported torch decoder checkpoint."""
+        from ..models.torch_port import import_torch_lm_state_dict
+
+        loaded = import_torch_lm_state_dict(params, self._load_torch_state_dict())
+        self.logger.info(
+            "Initialized %s from pretrained torch checkpoint %s",
+            self.model_name, self.pretrained,
+        )
+        return loaded
 
     def _train_loop(self, iter_generator, train_cfg):
         # --- the reference outer loop (:251-265), line for line -------------

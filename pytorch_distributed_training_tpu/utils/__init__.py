@@ -83,7 +83,9 @@ def enable_compile_cache(directory: str) -> str:
             pass
     jax.config.update("jax_compilation_cache_dir", directory)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # -1 disables the size floor; 0 would mean "filesystem-dependent default",
+    # which can silently reinstate a 64KB floor on some backends
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     return directory
 
 

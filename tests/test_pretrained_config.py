@@ -1,0 +1,184 @@
+"""model.pretrained: config-driven pretrained-weight ingestion.
+
+The user-facing form of the reference's TORCH_HOME model-zoo weights
+(/root/reference/train.sh:2, README.md:4): a torch ``state_dict`` checkpoint
+path in the ``model:`` section initializes the run from ported weights.
+The port machinery itself is pinned by tests/test_torch_port(_lm).py; these
+tests pin the CONFIG wiring — the Runner's initial state must equal the
+ported variables (and its eval step must reproduce torch eval logits), and
+mismatches must fail with descriptive errors, not part-load.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from test_torch_port import (
+    TorchBasicBlock,
+    TorchResNet,
+    _randomize_running_stats,
+)
+from test_torch_port_lm import DEPTH, EMBED, HEADS, MAXLEN, VOCAB, _randomized_twin
+
+from pytorch_distributed_training_tpu.engine import Runner
+
+
+class _CaptureRunner(Runner):
+    """Stops right before the training loop: captures the constructed state."""
+
+    def _train_loop(self, iter_generator, train_cfg):
+        self.captured = self.state
+
+
+def _image_cfg(tmp_path, ckpt, n_classes=10, **model_extra):
+    return {
+        "dataset": {
+            "name": "synthetic",
+            "root": str(tmp_path),
+            "n_classes": n_classes,
+            "image_size": 64,
+            "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {
+                "name": "SGD", "lr": 0.05, "weight_decay": 1.0e-4, "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [4], "gamma": 0.1},
+            "train_iters": 2,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": False,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ResNet18", "pretrained": str(ckpt), **model_extra},
+    }
+
+
+def _run_captured(cfg):
+    runner = _CaptureRunner(
+        num_nodes=1, rank=0, seed=3, dist_url="tcp://127.0.0.1:9917",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: None,
+    )
+    runner()
+    return runner
+
+
+def test_pretrained_resnet_initial_eval_matches_torch(tmp_path):
+    """Config-driven run starts at the ported weights: the Runner's own eval
+    step on the pretrained state reproduces torch eval logits."""
+    torch.manual_seed(0)
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=10)
+    _randomize_running_stats(tmodel, seed=1)
+    tmodel.eval()
+    ckpt = tmp_path / "resnet18.pt"
+    torch.save(tmodel.state_dict(), ckpt)
+
+    runner = _run_captured(_image_cfg(tmp_path, ckpt))
+    state = runner.captured
+
+    rng = np.random.default_rng(5)
+    img = rng.standard_normal((8, 64, 64, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    out = np.asarray(
+        runner.model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            jnp.asarray(img),
+            train=False,
+        )
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_pretrained_lm_params_match_direct_port(tmp_path):
+    from pytorch_distributed_training_tpu.models.torch_port import (
+        import_torch_lm_state_dict,
+    )
+
+    tm = _randomized_twin()
+    ckpt = tmp_path / "lm.pt"
+    torch.save(tm.state_dict(), ckpt)
+
+    cfg = {
+        "dataset": {
+            "name": "synthetic_text",
+            "root": str(tmp_path),
+            "n_classes": VOCAB,
+            "n_samples": 64,
+            "seq_len": MAXLEN,
+        },
+        "training": {
+            "optimizer": {"name": "AdamW", "lr": 3.0e-4, "weight_decay": 0.1},
+            "lr_schedule": {"name": "cosine", "total_iters": 100},
+            "train_iters": 2,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 8,
+            "num_workers": 2,
+            "sync_bn": False,
+        },
+        "validation": {"batch_size": 8, "num_workers": 2},
+        "model": {
+            "name": "TransformerLM",
+            "pretrained": str(ckpt),
+            "embed_dim": EMBED,
+            "depth": DEPTH,
+            "num_heads": HEADS,
+            "max_len": MAXLEN,
+        },
+    }
+    runner = _run_captured(cfg)
+    state = runner.captured
+
+    template = jax.tree.map(np.asarray, state.params)
+    expected = import_torch_lm_state_dict(template, tm.state_dict())
+    got_flat = jax.tree_util.tree_leaves_with_path(
+        jax.tree.map(np.asarray, state.params)
+    )
+    exp_flat = dict(
+        (jax.tree_util.keystr(p), leaf)
+        for p, leaf in jax.tree_util.tree_leaves_with_path(expected)
+    )
+    assert got_flat, "empty params"
+    for path, leaf in got_flat:
+        np.testing.assert_array_equal(leaf, exp_flat[jax.tree_util.keystr(path)])
+
+
+def test_pretrained_missing_file_raises(tmp_path):
+    cfg = _image_cfg(tmp_path, tmp_path / "nope.pt")
+    with pytest.raises(FileNotFoundError, match="model.pretrained"):
+        _run_captured(cfg)
+
+
+def test_pretrained_wrong_topology_raises(tmp_path):
+    """A ResNet-34-shaped dict into a ResNet-18 config: descriptive failure,
+    not a silent part-load."""
+    torch.manual_seed(0)
+    tmodel = TorchResNet(TorchBasicBlock, [3, 4, 6, 3], num_classes=10)
+    ckpt = tmp_path / "resnet34.pt"
+    torch.save(tmodel.state_dict(), ckpt)
+    with pytest.raises(KeyError, match="not consumed|missing"):
+        _run_captured(_image_cfg(tmp_path, ckpt))
+
+
+def test_pretrained_wrong_classes_raises(tmp_path):
+    torch.manual_seed(0)
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=7)
+    ckpt = tmp_path / "resnet18c7.pt"
+    torch.save(tmodel.state_dict(), ckpt)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        _run_captured(_image_cfg(tmp_path, ckpt, n_classes=10))
+
+
+def test_pretrained_vit_unsupported(tmp_path):
+    ckpt = tmp_path / "any.pt"
+    torch.save({}, ckpt)
+    cfg = _image_cfg(tmp_path, ckpt)
+    cfg["model"]["name"] = "ViT-Ti16"
+    with pytest.raises(ValueError, match="ResNet family"):
+        _run_captured(cfg)
