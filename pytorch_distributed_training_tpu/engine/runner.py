@@ -278,18 +278,34 @@ class Runner:
         # context); tensor_par or zero (with or without seq_par) -> the GSPMD
         # path on a (data, sequence, model) mesh, where the partitioner
         # inserts the sequence resharding around attention (tp_steps.py).
-        # Additive key ``training.zero``: ZeRO-1 optimizer-state sharding
-        # over the data axis (GSPMD LM path; parallel/tensor.py).  Parsed
-        # here because it changes BOTH the path selection below and the
-        # model's attention mode.
-        self.zero = bool(train_cfg.get("zero", False))
+        # Additive key ``training.zero``: ZeRO stage 0|1|2 (True = 1) —
+        # optimizer-state sharding over the data axis, stage 2 adds sharded
+        # gradient buffers (GSPMD LM path; parallel/tensor.py).  Parsed here
+        # because it changes BOTH the path selection below and the model's
+        # attention mode.
+        zero_cfg = train_cfg.get("zero", False)
+        if isinstance(zero_cfg, bool):
+            self.zero = 1 if zero_cfg else 0  # True = ZeRO-1 (back-compat)
+        elif isinstance(zero_cfg, int) and zero_cfg in (0, 1, 2):
+            self.zero = zero_cfg
+        else:
+            raise ValueError(
+                f"training.zero must be a bool or a stage in (0, 1, 2), "
+                f"got {zero_cfg!r}"
+            )
         if self.zero and not self.is_lm:
             raise ValueError(
                 "training.zero is only wired for the LM task (GSPMD path)"
             )
-        # (round 3) training.zero composes with pipeline_parallelism: the
-        # PP step computes grads in its shard_map and runs the update
-        # outside under GSPMD with data-sharded moments (engine/pp_steps)
+        if self.zero >= 2 and self.pipe_par > 1:
+            # the pipeline step computes grads inside a manual shard_map with
+            # stage-sharded layouts — a different contract than ZeRO-2's
+            # data-axis gradient scatter (ZeRO-1 moments do compose there)
+            raise ValueError(
+                "training.zero: 2 does not compose with "
+                "pipeline_parallelism — use zero: 1 (sharded moments) "
+                "under the pipeline"
+            )
         if self.is_lm:
             for key, par in (
                 ("sequence_parallelism", self.seq_par),

@@ -49,7 +49,7 @@ def build_tp_lm_train_step(
     mesh: Mesh,
     donate: bool = True,
     label_smoothing: float = 0.0,
-    zero: bool = False,
+    zero: int = 0,
     grad_accum: int = 1,
 ):
     """Compile one DP x TP LM iteration (GSPMD-partitioned).
@@ -65,8 +65,27 @@ def build_tp_lm_train_step(
     of per-micro mean losses the exact full-batch objective; for MoE the
     aux loss (and routing capacity) is likewise per-micro — the average of
     per-micro aux terms, the standard accumulation semantics.
+
+    ``zero``: 0/False = mirrored optimizer state; 1/True = ZeRO-1 (moments
+    sharded over ``data``; the partitioner reduce-scatters grads into the
+    sharded update and all-gathers fresh params); 2 = ZeRO-2 — additionally
+    pins GRADIENT buffers to the same sharded layout via
+    ``with_sharding_constraint``, so each device holds only its 1/N grad
+    slice (and, under ``grad_accum``, a 1/N accumulator carried across
+    micro-batches) instead of a replicated full-gradient tree.  The update
+    math is identical in all three modes.
     """
     import jax.numpy as jnp
+
+    from ..parallel.tensor import zero_grad_shardings
+
+    zero = int(zero)
+
+    def shard_grads(grads):
+        """ZeRO-2: reduce-scatter gradients into their 1/N home slices."""
+        return jax.lax.with_sharding_constraint(
+            grads, zero_grad_shardings(grads, mesh)
+        )
 
     def loss_fn(p, tokens, labels):
         # mutable="intermediates" collects sown auxiliary objectives —
@@ -112,10 +131,16 @@ def build_tp_lm_train_step(
                 NamedSharding(mesh, micro_spec),
             )
             zero_g = jax.tree.map(jnp.zeros_like, state.params)
+            if zero >= 2:
+                zero_g = shard_grads(zero_g)
 
             def scan_step(carry, xy):
                 acc, loss_acc = carry
                 loss, grads = jax.value_and_grad(loss_fn)(state.params, *xy)
+                if zero >= 2:
+                    # each micro's grads land in their 1/N slices BEFORE the
+                    # add, keeping the carried accumulator sharded
+                    grads = shard_grads(grads)
                 return (jax.tree.map(jnp.add, acc, grads), loss_acc + loss), None
 
             (grads, loss_sum), _ = jax.lax.scan(
@@ -127,6 +152,8 @@ def build_tp_lm_train_step(
             loss, grads = jax.value_and_grad(loss_fn)(
                 state.params, tokens, labels
             )
+            if zero >= 2:
+                grads = shard_grads(grads)
         lr = lr_fn(state.opt_state.step)
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
         return (
@@ -152,7 +179,7 @@ def build_tp_lm_train_step(
     return compile_for
 
 
-def build_tp_lm_eval_step(model, mesh: Mesh, zero: bool = False):
+def build_tp_lm_eval_step(model, mesh: Mesh, zero: int = 0):
     """Compile the TP LM validation step (GSPMD-partitioned).
 
     Same contract as the other eval steps — replicated ``(loss, acc1,

@@ -35,6 +35,7 @@ __all__ = [
     "lm_tp_param_specs",
     "lm_tp_shardings",
     "tp_state_shardings",
+    "zero_grad_shardings",
     "mirror_opt_fields",
 ]
 
@@ -79,6 +80,23 @@ def zero_shard_moment(sh: NamedSharding, leaf, mesh: Mesh) -> NamedSharding:
     return sh
 
 
+def zero_grad_shardings(grads, mesh: Mesh):
+    """ZeRO-2 gradient sharding: the moment rule applied to gradient buffers.
+
+    Gradients mirror their parameter's shape, so the same
+    :func:`zero_shard_moment` rule (first free dim over ``data``) gives each
+    device a 1/N slice.  Used as a ``with_sharding_constraint`` inside the
+    GSPMD train step so XLA reduce-scatters gradients as they are produced —
+    the replicated full-gradient tree (and, under ``grad_accumulation``, the
+    accumulator carried across micro-batches) never materializes per device.
+    Works on tracers: only ``shape``/``ndim`` are read.
+    """
+    param_sh = lm_tp_shardings(grads, mesh)
+    return jax.tree.map(
+        lambda sh, leaf: zero_shard_moment(sh, leaf, mesh), param_sh, grads
+    )
+
+
 def _spec_for(path) -> P:
     keys = [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
     leaf = keys[-1] if keys else ""
@@ -114,7 +132,7 @@ def lm_tp_shardings(params, mesh: Mesh):
     )
 
 
-def tp_state_shardings(state, mesh: Mesh, zero: bool = False):
+def tp_state_shardings(state, mesh: Mesh, zero: int = 0):
     """Shardings for a ``TrainState``: per-parameter optimizer moments
     (SGD momentum, AdamW mu/nu, ...) mirror their parameter's sharding.
 

@@ -103,6 +103,10 @@ SUPPORTED = [
                           sequence_parallelism=2, microbatches=4)),
     ("zeroxtp2", _cfg(zero=True, tensor_parallelism=2)),
     ("zeroxsp2", _cfg(zero=True, sequence_parallelism=2)),
+    ("zero2", _cfg(zero=2)),
+    ("zero2xtp2", _cfg(zero=2, tensor_parallelism=2)),
+    ("zero2xsp2", _cfg(zero=2, sequence_parallelism=2)),
+    ("zero2-grad-accum", _cfg(zero=2, grad_accumulation=2)),
     ("moe-ep4", _cfg(model_extra={"moe_experts": 4}, tensor_parallelism=4)),
     ("lm-grad-accum", _cfg(grad_accumulation=2)),
     ("lm-smoothing", _cfg(label_smoothing=0.1)),
@@ -131,6 +135,9 @@ UNSUPPORTED = [
      "ema is only wired for the image task"),
     ("zeroximg", _cfg(task="img", zero=True),
      "zero is only wired for the LM task"),
+    ("zero2xpp2", _cfg(zero=2, pipeline_parallelism=2, microbatches=4),
+     "zero: 2 does not compose with"),
+    ("zero3", _cfg(zero=3), "training.zero must be"),
     ("spximg", _cfg(task="img", sequence_parallelism=2),
      "require model.name: TransformerLM"),
     ("moe-odd-ep", _cfg(model_extra={"moe_experts": 3}, tensor_parallelism=2),

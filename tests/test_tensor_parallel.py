@@ -219,3 +219,62 @@ def test_zero1_sharded_moments_match_plain():
         f"{b}/{n}" for b in ("block0", "block1")
         for n in ("attn/qkv/bias", "mlp/fc1/bias")
     }, unsharded
+
+
+def test_zero2_sharded_grads_match_plain():
+    """training.zero: 2 (ZeRO-2): gradient buffers constrained to the
+    data-sharded layout must yield EXACTLY the plain-DP step — with and
+    without grad accumulation (which exercises the sharded accumulator
+    carried across micro-batches).
+
+    SGD+momentum, not AdamW: the scatter legitimately changes the f32
+    gradient-summation ORDER, and AdamW's ~sign(g) normalization amplifies
+    that rounding to O(lr) on near-zero grads — SGD keeps reduction-order
+    noise at rounding scale, so the comparison stays tight."""
+    from pytorch_distributed_training_tpu.parallel import make_3d_mesh
+    from pytorch_distributed_training_tpu.parallel.tensor import (
+        tp_state_shardings,
+        zero_grad_shardings,
+    )
+
+    tokens, labels = _data(seed=11)
+    opt = SGD(lr=0.05, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.05, [], 0.1)
+    model = _model()
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    mesh = make_3d_mesh(1, 2)  # data 4 x model 2
+
+    def run(zero, grad_accum):
+        state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+        state = jax.device_put(state, tp_state_shardings(state, mesh, zero=zero))
+        step = build_tp_lm_train_step(
+            model, opt, lr_fn, mesh, donate=False, zero=zero,
+            grad_accum=grad_accum,
+        )(state)
+        # two chained steps: the second consumes ZeRO-2's all-gathered params
+        s, _ = step(state, tokens, labels)
+        return step(s, tokens, labels)
+
+    s_plain, l_plain = run(zero=0, grad_accum=1)
+    for accum in (1, 2):
+        s_z2, l_z2 = run(zero=2, grad_accum=accum)
+        assert np.isclose(float(l_plain), float(l_z2), atol=1e-6), accum
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_plain.params),
+            jax.tree_util.tree_leaves(s_z2.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            )
+
+    # the gradient sharding rule itself: every moment-shardable leaf gets a
+    # data-axis dim, mirroring zero_shard_moment
+    from conftest import uses_mesh_axis
+
+    gsh = zero_grad_shardings(params, mesh)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): sh
+        for path, sh in jax.tree_util.tree_flatten_with_path(gsh)[0]
+    }
+    for name in ("block0/attn/qkv/kernel", "block0/mlp/fc2/kernel", "tok_embedding"):
+        assert uses_mesh_axis(flat[name], "data"), name
