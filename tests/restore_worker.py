@@ -1,0 +1,69 @@
+"""Subprocess worker: restore a checkpoint under a DIFFERENT topology.
+
+Driven by tests/test_checkpoint.py (cross-topology restore cases).  Runs a
+Runner whose device count / parallelism differs from the run that WROTE the
+checkpoint, stops right before the training loop, and dumps the restored
+params so the parent can verify orbax resharding produced identical values.
+
+Env:
+  RW_DEVICES   virtual CPU devices for this process
+  RW_CFG       path to the run config (JSON)
+  RW_OUT       output .npz path for the flattened restored params
+"""
+import json
+import os
+import sys
+
+devices = int(os.environ["RW_DEVICES"])
+cfg_path = os.environ["RW_CFG"]
+out_path = os.environ["RW_OUT"]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from pytorch_distributed_training_tpu.engine import Runner  # noqa: E402
+
+
+class _CaptureRunner(Runner):
+    """Setup (incl. checkpoint restore) only; no training iterations."""
+
+    def _train_loop(self, iter_generator, train_cfg):
+        self.captured_iter = self.iter
+
+
+def main():
+    with open(cfg_path) as fp:
+        cfg = json.load(fp)
+    runner = _CaptureRunner(
+        num_nodes=1, rank=0, seed=3, dist_url="tcp://127.0.0.1:9961",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: None,
+    )
+    runner()
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            runner.state.params
+        )[0]
+    }
+    np.savez(out_path, **flat)
+    meta = {
+        "device_count": jax.device_count(),
+        "restored_iter": int(runner.captured_iter),
+    }
+    with open(out_path + ".json", "w") as fp:
+        json.dump(meta, fp)
+
+
+if __name__ == "__main__":
+    main()

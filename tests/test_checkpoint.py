@@ -291,3 +291,145 @@ def test_restore_nonstructural_error_not_misdiagnosed(tmp_path):
         "corruption misdiagnosed as a params-layout mismatch:\n"
         f"{exc_info.value}"
     )
+
+
+# ----------------------------------------------------------------------
+# Cross-topology restore (round-3 VERDICT #6): a checkpoint written under
+# one parallelism layout must restore into another whenever the LOGICAL
+# state tree matches — orbax reshards to the target's shardings.  Layouts
+# that genuinely differ (stacked PP params) stay descriptive errors
+# (covered above).
+# ----------------------------------------------------------------------
+def _lm_cfg(tmp_path, train_iters=2, **train_extra):
+    return {
+        "dataset": {
+            "name": "synthetic_text",
+            "root": "/unused",
+            "n_classes": 64,
+            "seq_len": 32,
+            "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {
+                "name": "SGD", "lr": 0.01, "weight_decay": 1.0e-4, "momentum": 0.9,
+            },
+            "lr_schedule": {"name": "multi_step", "milestones": [100], "gamma": 0.1},
+            "train_iters": train_iters,
+            "print_interval": 10,
+            "val_interval": 100,
+            "batch_size": 16,
+            "num_workers": 1,
+            "sync_bn": False,
+            "checkpoint": {"dir": str(tmp_path / "ckpt"), "interval": 2},
+            **train_extra,
+        },
+        "validation": {"batch_size": 16, "num_workers": 1},
+        "model": {"name": "TransformerLM", "embed_dim": 32, "depth": 2,
+                  "num_heads": 4},
+    }
+
+
+class _SetupOnlyRunner(Runner):
+    """Runs worker setup (incl. restore); skips the training loop."""
+
+    def _train_loop(self, iter_generator, train_cfg):
+        self.captured_iter = self.iter
+
+
+def _setup_only(cfg):
+    runner = _SetupOnlyRunner(
+        num_nodes=1, rank=0, seed=3, dist_url="tcp://127.0.0.1:9902",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: None,
+    )
+    runner()
+    return runner
+
+
+def _flat(tree, materialize=True):
+    """Flatten to {path-string: leaf}; materialize=False keeps live arrays
+    (with their shardings) instead of host numpy copies."""
+    conv = np.asarray if materialize else (lambda x: x)
+    return {
+        "/".join(str(getattr(k, "key", k)) for k in path): conv(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
+
+
+@pytest.mark.parametrize(
+    "target_extra", [{"tensor_parallelism": 2}, {"zero": 1}, {"zero": 2}],
+    ids=["tp2", "zero1", "zero2"],
+)
+def test_dp_checkpoint_restores_into_resharded_run(tmp_path, target_extra):
+    """A plain-DP LM checkpoint restores into TP=2 / ZeRO-1 / ZeRO-2 runs:
+    identical values, target-topology shardings (orbax resharding)."""
+    writer = _run(_lm_cfg(tmp_path, train_iters=2))
+    want_params = _flat(writer.state.params)
+    want_mu = _flat(writer.state.opt_state.momentum)
+
+    reader = _setup_only(_lm_cfg(tmp_path, train_iters=2, **target_extra))
+    assert reader.captured_iter == 2  # resumed past the saved step
+    got_params = _flat(reader.state.params)
+    got_mu = _flat(reader.state.opt_state.momentum)
+    assert set(got_params) == set(want_params)
+    for name in want_params:
+        np.testing.assert_array_equal(got_params[name], want_params[name], err_msg=name)
+    for name in want_mu:
+        np.testing.assert_array_equal(got_mu[name], want_mu[name], err_msg=name)
+
+    # the restored state is in the TARGET topology's layout, not the writer's
+    from conftest import uses_mesh_axis
+
+    flat_live = _flat(reader.state.params, materialize=False)
+    if "tensor_parallelism" in target_extra:
+        assert uses_mesh_axis(
+            flat_live["block0/attn/qkv/kernel"].sharding, "model"
+        )
+    else:
+        flat_mu_live = _flat(reader.state.opt_state.momentum, materialize=False)
+        assert uses_mesh_axis(
+            flat_mu_live["block0/attn/qkv/kernel"].sharding, "data"
+        )
+    # and the compiled step accepts it (one extra iteration runs cleanly)
+    cont = _run(_lm_cfg(tmp_path, train_iters=3, **target_extra))
+    assert int(cont.state.step) == 3
+
+
+@pytest.mark.slow
+def test_restore_at_different_device_count(tmp_path):
+    """batch_division: world — a checkpoint written on the 8-device mesh
+    restores in a 4-device process (orbax resharding across world sizes),
+    bit-identical params."""
+    import subprocess
+    import sys
+    import json as _json
+    import os
+
+    cfg = _lm_cfg(tmp_path, train_iters=2, batch_division="world")
+    writer = _run(cfg)
+    want = _flat(writer.state.params)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_json.dumps(cfg))
+    out_path = tmp_path / "restored.npz"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(
+        RW_DEVICES="4", RW_CFG=str(cfg_path), RW_OUT=str(out_path),
+        PYTHONPATH=root + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tests", "restore_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(str(out_path) + ".json") as fp:
+        meta = _json.load(fp)
+    assert meta["device_count"] == 4
+    assert meta["restored_iter"] == 2
+    got = dict(np.load(str(out_path)))
+    assert set(got) == set(want)
+    for name in want:
+        np.testing.assert_array_equal(got[name], want[name], err_msg=name)
