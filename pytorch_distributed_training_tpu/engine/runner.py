@@ -36,7 +36,6 @@ from logging.handlers import QueueHandler
 from typing import Callable, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import tqdm
 
@@ -50,25 +49,15 @@ from ..data import (
     get_dataset,
 )
 from ..metrics import AverageMeter
-from ..models import get_model
 from ..optimizers import get_optimizer
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from ..parallel import (
-    DATA_AXIS,
-    batch_sharding,
-    initialize_distributed,
-    make_mesh,
-    make_sp_mesh,
-    replicated_sharding,
-)
-from ..parallel.sequence import SEQUENCE_AXIS
+from ..parallel import initialize_distributed
 from ..schedulers import get_scheduler
 from ..utils import enable_compile_cache, make_deterministic, make_iter_dataloader
 from .checkpoint import Checkpointer
+from .paths import select_path
 from .profiling import TraceProfiler
-from .sp_steps import build_lm_eval_step, build_lm_train_step
-from .steps import TrainState, build_eval_step, build_train_step, init_train_state
+from .steps import TrainState
+from .topology import parse_batch, parse_topology
 
 __all__ = ["Runner"]
 
@@ -170,294 +159,12 @@ class Runner:
             cfg["dataset"]["name"], cfg["dataset"]["root"], split="val", **ds_kwargs
         )
 
-        self.compute_dtype = {
-            "float32": jnp.float32,
-            "bfloat16": jnp.bfloat16,
-        }[train_cfg.get("dtype", "float32")]
-        # Model section: ``name`` is the reference's only key (:183-186);
-        # extra keys are architecture hyperparameters forwarded to the zoo
-        # (additive — e.g. embed_dim/depth/num_heads for TransformerLM).
-        model_cfg = dict(cfg["model"])
-        model_name = model_cfg.pop("name")
-        self.model_name = model_name
-        # Additive key ``model.pretrained``: initialize the run from a torch
-        # ``state_dict`` checkpoint (torchvision layout for the ResNet family,
-        # the twin layout of tests/test_torch_port_lm.py for TransformerLM) —
-        # the user-facing form of the reference's TORCH_HOME model-zoo
-        # weights (/root/reference/train.sh:2).  Ported via models/torch_port
-        # at state construction below; strict shape/name checking raises
-        # descriptive errors instead of silently part-loading.
-        self.pretrained = model_cfg.pop("pretrained", None)
-        # The long-context LM task (beyond the reference, SURVEY.md §5.7):
-        # first-class from the config surface — ``model.name:
-        # TransformerLM`` + an LM dataset + optional
-        # ``training.sequence_parallelism`` (ring/Ulysses over a sequence
-        # mesh axis, parallel.sequence).
-        self.is_lm = model_name.lower() == "transformerlm"
-        # MoE (model.moe_experts > 0, ops/moe.py): trains on the GSPMD path
-        # whatever the parallelism degrees — the routing einsums and the
-        # sown aux loss need the partitioner's global-token view, and under
-        # tensor_parallelism the stacked expert weights shard over the
-        # model axis (expert parallelism).
-        self.is_moe = self.is_lm and int(model_cfg.get("moe_experts", 0) or 0) > 0
-        if self.pretrained and self.is_moe:
-            # the torch-twin LM layout has no expert tensors — a part-load
-            # would silently leave experts at random init
-            raise ValueError(
-                "model.pretrained does not support MoE models "
-                "(no torch-twin layout for expert weights)"
-            )
-        sync_bn = (
-            bool(train_cfg["sync_bn"]) and self.distributed and not self.is_lm
-        )
-        self.seq_par = int(train_cfg.get("sequence_parallelism", 1))
-        self.tensor_par = int(train_cfg.get("tensor_parallelism", 1))
-        # Additive key ``training.pipeline_parallelism``: GPipe microbatch
-        # pipeline over a (data, stage) mesh (parallel/pipeline.py,
-        # engine/pp_steps.py).  ``training.microbatches`` tunes the schedule
-        # (default = stage count; the bubble fraction is (S-1)/(M+S-1)).
-        self.pipe_par = int(train_cfg.get("pipeline_parallelism", 1))
-        self.microbatches = int(train_cfg.get("microbatches", self.pipe_par))
-        if "microbatches" in train_cfg and self.pipe_par <= 1:
-            # silently ignoring the key would read as "microbatch streaming
-            # enabled" — grad_accumulation is the non-pipelined equivalent
-            raise ValueError(
-                "training.microbatches requires pipeline_parallelism > 1 "
-                "(use training.grad_accumulation for non-pipelined "
-                "micro-batching)"
-            )
-        if (
-            self.seq_par > 1 or self.tensor_par > 1 or self.pipe_par > 1
-        ) and not self.is_lm:
-            raise ValueError(
-                "training.sequence_parallelism / tensor_parallelism / "
-                "pipeline_parallelism require model.name: TransformerLM"
-            )
-        if self.pipe_par > 1 and self.seq_par > 1 and self.tensor_par > 1:
-            # the pipeline mesh supports ONE inner axis besides stage:
-            # model (PP x TP) or sequence (PP x SP) — a 4-axis composition
-            # is not wired (parallel/pipeline.make_pp_mesh)
-            raise ValueError(
-                "pipeline_parallelism x sequence_parallelism x "
-                "tensor_parallelism (three-way) is not wired; pick "
-                "PP x SP or PP x TP"
-            )
-        # Additive key ``training.pp_schedule``: microbatch schedule for the
-        # pipeline step — "gpipe" (autodiff backward, O(M) activation
-        # residuals) or "1f1b" (manual interleaved backward with per-stage
-        # recompute, O(S) buffered microbatch inputs; engine/pp_steps.py).
-        self.pp_schedule = str(train_cfg.get("pp_schedule", "gpipe"))
-        if self.pp_schedule not in ("gpipe", "1f1b"):
-            raise ValueError(
-                f"training.pp_schedule must be 'gpipe' or '1f1b', "
-                f"got {self.pp_schedule!r}"
-            )
-        if "pp_schedule" in train_cfg and self.pipe_par <= 1:
-            raise ValueError(
-                "training.pp_schedule requires pipeline_parallelism > 1"
-            )
-        if self.pipe_par > 1 and self.is_moe:
-            # MoE blocks break the homogeneous stacked-layer layout the
-            # pipeline step scans over, and its sown aux loss is discarded
-            # by the manual per-stage block apply
-            raise ValueError(
-                "model.moe_experts does not compose with pipeline_parallelism"
-            )
-        if self.is_moe and int(model_cfg.get("moe_experts")) % self.tensor_par != 0:
-            raise ValueError(
-                f"model.moe_experts ({model_cfg.get('moe_experts')}) must be "
-                f"divisible by training.tensor_parallelism ({self.tensor_par}) "
-                "for an even expert split"
-            )
-        if self.microbatches < max(self.pipe_par, 1):
-            raise ValueError(
-                f"training.microbatches ({self.microbatches}) must be >= "
-                f"pipeline_parallelism ({self.pipe_par})"
-            )
-        # seq_par alone -> shard_map ring attention (memory-optimal for long
-        # context); tensor_par or zero (with or without seq_par) -> the GSPMD
-        # path on a (data, sequence, model) mesh, where the partitioner
-        # inserts the sequence resharding around attention (tp_steps.py).
-        # Additive key ``training.zero``: ZeRO stage 0|1|2 (True = 1) —
-        # optimizer-state sharding over the data axis, stage 2 adds sharded
-        # gradient buffers (GSPMD LM path; parallel/tensor.py).  Parsed here
-        # because it changes BOTH the path selection below and the model's
-        # attention mode.
-        zero_cfg = train_cfg.get("zero", False)
-        if isinstance(zero_cfg, bool):
-            self.zero = 1 if zero_cfg else 0  # True = ZeRO-1 (back-compat)
-        elif isinstance(zero_cfg, int) and zero_cfg in (0, 1, 2):
-            self.zero = zero_cfg
-        else:
-            raise ValueError(
-                f"training.zero must be a bool or a stage in (0, 1, 2), "
-                f"got {zero_cfg!r}"
-            )
-        if self.zero and not self.is_lm:
-            raise ValueError(
-                "training.zero is only wired for the LM task (GSPMD path)"
-            )
-        if self.zero >= 2 and self.pipe_par > 1:
-            # the pipeline step computes grads inside a manual shard_map with
-            # stage-sharded layouts — a different contract than ZeRO-2's
-            # data-axis gradient scatter (ZeRO-1 moments do compose there)
-            raise ValueError(
-                "training.zero: 2 does not compose with "
-                "pipeline_parallelism — use zero: 1 (sharded moments) "
-                "under the pipeline"
-            )
-        if self.is_lm:
-            for key, par in (
-                ("sequence_parallelism", self.seq_par),
-                ("tensor_parallelism", self.tensor_par),
-                ("pipeline_parallelism", self.pipe_par),
-            ):
-                if par < 1 or jax.local_device_count() % par != 0:
-                    # the host-batch layout (and
-                    # make_array_from_process_local_data) assumes each host
-                    # holds whole shard groups
-                    raise ValueError(
-                        f"training.{key} ({par}) must divide the local "
-                        f"device count ({jax.local_device_count()})"
-                    )
-            non_data_par = self.seq_par * self.tensor_par * self.pipe_par
-            if jax.local_device_count() % non_data_par != 0:
-                # combined: one data shard spans a seq x tensor x pipe
-                # device group — the whole group must fit within a host or
-                # units_local becomes 0 and the host batch degenerates
-                raise ValueError(
-                    f"sequence_parallelism x tensor_parallelism x "
-                    f"pipeline_parallelism ({self.seq_par} x {self.tensor_par}"
-                    f" x {self.pipe_par}) must divide the local device count "
-                    f"({jax.local_device_count()})"
-                )
-            sample_inp, _ = train_dataset[0]
-            self.seq_len = int(sample_inp.shape[0])
-            if self.seq_len % self.seq_par != 0:
-                raise ValueError(
-                    f"dataset.seq_len ({self.seq_len}) must be divisible by "
-                    f"training.sequence_parallelism ({self.seq_par})"
-                )
-            model_cfg.setdefault("max_len", self.seq_len)
-            if (
-                self.seq_par > 1
-                and self.tensor_par == 1
-                and self.pipe_par == 1
-                and not self.zero
-                and not self.is_moe
-            ):
-                # ring-attention path only; the GSPMD path (tensor_par or
-                # zero or MoE) keeps seq_axis=None and lets the partitioner
-                # distribute, and the PP x SP path builds its own
-                # seq_axis'd stage blocks (pp_steps._stage_applies) — a
-                # seq_axis model requires shard_map
-                model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
-            self.model = get_model(
-                model_name,
-                num_classes=cfg["dataset"]["n_classes"],
-                dtype=self.compute_dtype,
-                **model_cfg,
-            )
-            if self.is_moe and not (
-                1 <= self.model.moe_every <= self.model.depth
-            ):
-                # read from the CONSTRUCTED model, not re-hardcoded class
-                # defaults (r2 review): moe_every 0 would div-by-zero at
-                # init; > depth silently trains a fully dense model while
-                # every MoE restriction still applies
-                raise ValueError(
-                    f"model.moe_every ({self.model.moe_every}) must be in "
-                    f"[1, depth={self.model.depth}] (moe_every > depth "
-                    "would make no block MoE)"
-                )
-        else:
-            # reference behavior: only ``model.name`` is read for the image
-            # zoo — extra keys stay ignored (forwarding them would crash
-            # ResNet/ViT constructors on e.g. annotation-only keys)
-            self.model = get_model(
-                model_name,
-                num_classes=cfg["dataset"]["n_classes"],
-                axis_name=DATA_AXIS if sync_bn else None,
-                dtype=self.compute_dtype,
-            )
-
-        batch_size = train_cfg["batch_size"]
+        # Flags, parallelism degrees, cross-constraints + model construction
+        # (engine/topology.py — extracted, semantics unchanged; every
+        # documented config error lives there).
+        parse_topology(self, cfg, train_cfg, train_dataset)
+        host_batch = parse_batch(self, train_cfg)
         n_workers = train_cfg["num_workers"]
-        local_devices = jax.local_device_count()
-        # SURVEY §7 stage 4 decision, config-gated (additive key, unknown to
-        # the reference schema):
-        #   batch_division: local  — reference parity (:194): per-device batch
-        #       divides by the LOCAL device count, so the global batch scales
-        #       with node count (default).
-        #   batch_division: world  — divide by the WORLD device count, so cfg
-        #       batch_size IS the global batch at any topology.
-        division = train_cfg.get("batch_division", "local")
-        if division not in ("local", "world"):
-            raise ValueError(
-                f"training.batch_division must be 'local' or 'world', got {division!r}"
-            )
-        # Batch rows shard over the DATA axis only; each data shard spans a
-        # seq_par x tensor_par device group (either may be 1), so the
-        # division unit is a data shard, not a device.
-        non_data = (
-            self.seq_par * self.tensor_par * self.pipe_par if self.is_lm else 1
-        )
-        units_local = local_devices // non_data
-        units_world = self.world_size // non_data
-        # Additive key ``training.grad_accumulation``: per-step micro-batch
-        # count (lax.scan inside the compiled step — activation memory / N,
-        # identical update math; engine/steps.py).
-        self.grad_accum = int(train_cfg.get("grad_accumulation", 1))
-        if self.grad_accum < 1:
-            raise ValueError(f"grad_accumulation must be >= 1, got {self.grad_accum}")
-        if self.grad_accum > 1 and self.pipe_par > 1:
-            raise ValueError(
-                "grad_accumulation is redundant under pipeline_parallelism — "
-                "raise training.microbatches instead (same memory effect, "
-                "and it also shrinks the pipeline bubble)"
-            )
-        # Additive keys: torch-convention label smoothing + params EMA
-        # (evaluation runs with the EMA weights when enabled).
-        self.label_smoothing = float(train_cfg.get("label_smoothing", 0.0))
-        if not (0.0 <= self.label_smoothing < 1.0):
-            raise ValueError(
-                f"label_smoothing must be in [0, 1), got {self.label_smoothing}"
-            )
-        ema_cfg = train_cfg.get("ema")
-        self.ema_decay = float(ema_cfg["decay"]) if ema_cfg else None
-        if self.ema_decay is not None and not (0.0 < self.ema_decay < 1.0):
-            raise ValueError(f"ema.decay must be in (0, 1), got {self.ema_decay}")
-        if self.ema_decay is not None and self.is_lm:
-            raise ValueError("training.ema is only wired for the image task")
-        if self.distributed:
-            divisor = units_world if division == "world" else units_local
-            per_device_batch = batch_size // max(divisor, 1)
-            if per_device_batch == 0 or divisor == 0:
-                raise ValueError(
-                    f"batch_size {batch_size} < {division} batch-shard count {divisor}"
-                )
-            if division == "world" and batch_size % divisor != 0:
-                # the mode's whole contract is "cfg batch_size IS the global
-                # batch" — a silent floor would break it, so fail loudly
-                raise ValueError(
-                    f"batch_division: world requires batch_size ({batch_size}) "
-                    f"divisible by the world batch-shard count ({divisor})"
-                )
-            host_batch = per_device_batch * units_local
-        else:
-            host_batch = batch_size
-            per_device_batch = batch_size
-        if per_device_batch % self.grad_accum != 0:
-            # fail fast like every other config error, not at jit trace time
-            raise ValueError(
-                f"per-shard batch ({per_device_batch}) not divisible by "
-                f"training.grad_accumulation ({self.grad_accum})"
-            )
-        if self.pipe_par > 1 and per_device_batch % self.microbatches != 0:
-            raise ValueError(
-                f"per-shard batch ({per_device_batch}) not divisible by "
-                f"training.microbatches ({self.microbatches})"
-            )
         # One controller per host: cfg num_workers = decode threads per host
         # (the reference divides workers among its per-GPU processes, :195 —
         # same total per host).
@@ -553,178 +260,12 @@ class Runner:
             len(self.val_loader),
         )
 
-        # --- mesh + compiled steps + replicated state -----------------------
-        if self.is_lm and self.pipe_par > 1:
-            # (data, stage) mesh, GPipe microbatch schedule as one shard_map
-            # program (parallel/pipeline.py, engine/pp_steps.py): decoder
-            # blocks stack into a leading layer axis sharded over stage,
-            # activations rotate stage-to-stage via ppermute each tick.
-            from ..optimizers import LARS
-            from ..parallel import (
-                make_pp_mesh,
-                pp_stack_params,
-                pp_state_shardings,
-            )
-            from .pp_steps import build_pp_lm_eval_step, build_pp_lm_train_step
-
-            if self.model.depth % self.pipe_par != 0:
-                raise ValueError(
-                    f"model.depth ({self.model.depth}) must be divisible by "
-                    f"training.pipeline_parallelism ({self.pipe_par})"
-                )
-            if isinstance(self.optimizer, LARS):
-                # LARS takes per-parameter norms; on the stacked layer axis
-                # those would span a whole stage's layers — different math
-                raise ValueError(
-                    "optimizer LARS is not supported with "
-                    "pipeline_parallelism (per-parameter trust ratios do not "
-                    "survive the stacked-layer param layout)"
-                )
-            if self.tensor_par > 1 and self.model.num_heads % self.tensor_par:
-                # same whole-head Megatron split constraint as the TP path
-                raise ValueError(
-                    f"model.num_heads ({self.model.num_heads}) must be "
-                    f"divisible by training.tensor_parallelism "
-                    f"({self.tensor_par})"
-                )
-            self.mesh = make_pp_mesh(
-                self.pipe_par, self.tensor_par, self.seq_par
-            )
-            pp_seq_axis = SEQUENCE_AXIS if self.seq_par > 1 else None
-            sample = jnp.zeros((1, self.seq_len), jnp.int32)
-            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
-            if self.pretrained:
-                params = self._apply_pretrained_lm(params)
-            pp_params = pp_stack_params(params, self.model.depth)
-            state = TrainState(
-                params=pp_params,
-                batch_stats={},
-                opt_state=self.optimizer.init(pp_params),
-            )
-            self.state = jax.device_put(
-                state, pp_state_shardings(state, self.mesh, zero=self.zero)
-            )
-            self.train_step = build_pp_lm_train_step(
-                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
-                num_microbatches=self.microbatches,
-                label_smoothing=self.label_smoothing,
-                schedule=self.pp_schedule,
-                seq_axis=pp_seq_axis,
-                zero=self.zero,
-            )(self.state)
-            self.eval_step = build_pp_lm_eval_step(
-                self.model, self.mesh, self.microbatches,
-                seq_axis=pp_seq_axis,
-            )(self.state)
-            tok_sharding = NamedSharding(
-                self.mesh, P(DATA_AXIS, pp_seq_axis)
-            )
-            self._img_sharding = tok_sharding
-            self._label_sharding = tok_sharding
-        elif self.is_lm and (self.tensor_par > 1 or self.zero or self.is_moe):
-            # (data, sequence, model) mesh, GSPMD Megatron sharding
-            # (parallel/tensor): params live sharded over the model axis;
-            # XLA inserts the row-parallel all-reduces, the gradient
-            # all-reduce, and — when sequence_parallelism > 1 — the
-            # sequence resharding around attention.  ``training.zero``
-            # additionally shards optimizer moments over the data axis
-            # (ZeRO-1) and selects this GSPMD path even at tensor_par == 1.
-            # MoE models (``model.moe_experts``) also land here: expert
-            # weights shard over the model axis (expert parallelism) and
-            # the train step folds the sown aux loss into the objective
-            from ..parallel import make_3d_mesh
-            from ..parallel.tensor import tp_state_shardings
-            from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step
-
-            if self.model.num_heads % self.tensor_par != 0:
-                # the Megatron column split lands on whole-head boundaries
-                raise ValueError(
-                    f"model.num_heads ({self.model.num_heads}) must be "
-                    f"divisible by training.tensor_parallelism ({self.tensor_par})"
-                )
-            self.mesh = make_3d_mesh(self.seq_par, self.tensor_par)
-            sample = jnp.zeros((1, self.seq_len), jnp.int32)
-            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
-            if self.pretrained:
-                params = self._apply_pretrained_lm(params)
-            state = TrainState(
-                params=params,
-                batch_stats={},
-                opt_state=self.optimizer.init(params),
-            )
-            self.state = jax.device_put(
-                state, tp_state_shardings(state, self.mesh, zero=self.zero)
-            )
-            self.train_step = build_tp_lm_train_step(
-                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
-                label_smoothing=self.label_smoothing, zero=self.zero,
-                grad_accum=self.grad_accum,
-            )(self.state)
-            self.eval_step = build_tp_lm_eval_step(
-                self.model, self.mesh, zero=self.zero
-            )(self.state)
-            tok_sharding = NamedSharding(
-                self.mesh, P(DATA_AXIS, SEQUENCE_AXIS)
-            )
-            self._img_sharding = tok_sharding
-            self._label_sharding = tok_sharding
-        elif self.is_lm:
-            # (data, sequence) mesh; with sequence_parallelism == 1 the
-            # sequence axis is trivial and this is plain DP over tokens
-            self.mesh = make_sp_mesh(self.seq_par)
-            sample = jnp.zeros((1, self.seq_len), jnp.int32)
-            params = self.model.init(jax.random.PRNGKey(seed), sample)["params"]
-            if self.pretrained:
-                params = self._apply_pretrained_lm(params)
-            state = TrainState(
-                params=params,
-                batch_stats={},
-                opt_state=self.optimizer.init(params),
-            )
-            self.state = jax.device_put(state, replicated_sharding(self.mesh))
-            self.train_step = build_lm_train_step(
-                self.model, self.optimizer, self.scheduler.lr_fn, self.mesh,
-                grad_accum=self.grad_accum,
-                label_smoothing=self.label_smoothing,
-            )
-            self.eval_step = build_lm_eval_step(self.model, self.mesh)
-            # tokens/targets are [batch, seq], sharded over BOTH mesh axes
-            tok_sharding = NamedSharding(self.mesh, P(DATA_AXIS, SEQUENCE_AXIS))
-            self._img_sharding = tok_sharding
-            self._label_sharding = tok_sharding
-        else:
-            self.mesh = make_mesh()
-            sample_img, _ = train_dataset[0]
-            sample = jnp.zeros((1,) + tuple(sample_img.shape), jnp.float32)
-            state = init_train_state(
-                self.model, self.optimizer, jax.random.PRNGKey(seed), sample
-            )
-            if self.pretrained:
-                # before the EMA copy below, so the average starts from the
-                # pretrained weights too
-                state = self._apply_pretrained_image(state)
-            if self.ema_decay is not None:
-                # EMA starts at the initial weights (standard convention).
-                # jnp.copy: ema must NOT alias the params buffers — the
-                # donated train step would otherwise donate them twice
-                state = state.replace(ema=jax.tree.map(jnp.copy, state.params))
-            self.state = jax.device_put(state, replicated_sharding(self.mesh))
-            self.train_step = build_train_step(
-                self.model,
-                self.optimizer,
-                self.scheduler.lr_fn,
-                self.mesh,
-                sync_bn=sync_bn,
-                input_norm=self._input_norm,
-                grad_accum=self.grad_accum,
-                label_smoothing=self.label_smoothing,
-                ema_decay=self.ema_decay,
-            )
-            self.eval_step = build_eval_step(
-                self.model, self.mesh, input_norm=self._input_norm
-            )
-            self._img_sharding = batch_sharding(self.mesh, ndim=4)
-            self._label_sharding = batch_sharding(self.mesh, ndim=1)
+        # --- mesh + compiled steps + sharded state (engine/paths.py) --------
+        # Strategy table: the first matching PathSpec builds mesh, state,
+        # train/eval steps, and the input shardings for this topology.
+        path = select_path(self)
+        self.logger.info("Execution path: %s", path.name)
+        path.build(self, seed, train_dataset)
         self.global_batch = host_batch * n_hosts
         self._tput_t0 = time.monotonic()
         self._tput_iters = 0

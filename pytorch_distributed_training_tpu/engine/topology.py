@@ -1,0 +1,312 @@
+"""Topology/config parsing for the Runner: flags, validation, model build.
+
+Extracted from ``Runner.worker`` (round-3 VERDICT weak #5: the 630-line
+method's four-way path selection deserved extraction before a fifth path
+lands).  Everything here is pure config -> attributes/raises: the semantics
+(and every documented error message the composition-matrix tests pin,
+tests/test_composition_matrix.py) are unchanged.
+
+Two stages, called in order by ``Runner.worker``:
+
+  - :func:`parse_topology` — compute dtype, model-section keys
+    (``pretrained``, MoE), the parallelism degrees (SP/TP/PP/microbatches/
+    schedule/ZeRO) with their cross-constraints, and the constructed model.
+  - :func:`parse_batch` — batch division (``local``/``world``,
+    SURVEY §7 stage 4), grad accumulation, label smoothing, EMA; returns the
+    per-host batch.
+
+The actual mesh/step construction lives in :mod:`.paths` (the strategy
+table keyed on the flags this module sets).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models import get_model
+from ..parallel import DATA_AXIS
+from ..parallel.sequence import SEQUENCE_AXIS
+
+__all__ = ["parse_topology", "parse_batch"]
+
+
+def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
+    """Parse model + parallelism config onto Runner ``r`` and build
+    ``r.model``.  Raises the documented ``ValueError`` for every unsupported
+    combination (the composition matrix's source of truth)."""
+    r.compute_dtype = {
+        "float32": jnp.float32,
+        "bfloat16": jnp.bfloat16,
+    }[train_cfg.get("dtype", "float32")]
+    # Model section: ``name`` is the reference's only key (:183-186);
+    # extra keys are architecture hyperparameters forwarded to the zoo
+    # (additive — e.g. embed_dim/depth/num_heads for TransformerLM).
+    model_cfg = dict(cfg["model"])
+    model_name = model_cfg.pop("name")
+    r.model_name = model_name
+    # Additive key ``model.pretrained``: initialize the run from a torch
+    # ``state_dict`` checkpoint (torchvision layout for the ResNet family,
+    # the twin layout of tests/test_torch_port_lm.py for TransformerLM) —
+    # the user-facing form of the reference's TORCH_HOME model-zoo
+    # weights (/root/reference/train.sh:2).  Ported via models/torch_port
+    # at state construction (engine/paths.py); strict shape/name checking
+    # raises descriptive errors instead of silently part-loading.
+    r.pretrained = model_cfg.pop("pretrained", None)
+    # The long-context LM task (beyond the reference, SURVEY.md §5.7):
+    # first-class from the config surface — ``model.name: TransformerLM`` +
+    # an LM dataset + optional ``training.sequence_parallelism``
+    # (ring/Ulysses over a sequence mesh axis, parallel.sequence).
+    r.is_lm = model_name.lower() == "transformerlm"
+    # MoE (model.moe_experts > 0, ops/moe.py): trains on the GSPMD path
+    # whatever the parallelism degrees — the routing einsums and the
+    # sown aux loss need the partitioner's global-token view, and under
+    # tensor_parallelism the stacked expert weights shard over the
+    # model axis (expert parallelism).
+    r.is_moe = r.is_lm and int(model_cfg.get("moe_experts", 0) or 0) > 0
+    if r.pretrained and r.is_moe:
+        # the torch-twin LM layout has no expert tensors — a part-load
+        # would silently leave experts at random init
+        raise ValueError(
+            "model.pretrained does not support MoE models "
+            "(no torch-twin layout for expert weights)"
+        )
+    r.sync_bn = bool(train_cfg["sync_bn"]) and r.distributed and not r.is_lm
+    r.seq_par = int(train_cfg.get("sequence_parallelism", 1))
+    r.tensor_par = int(train_cfg.get("tensor_parallelism", 1))
+    # Additive key ``training.pipeline_parallelism``: GPipe microbatch
+    # pipeline over a (data, stage) mesh (parallel/pipeline.py,
+    # engine/pp_steps.py).  ``training.microbatches`` tunes the schedule
+    # (default = stage count; the bubble fraction is (S-1)/(M+S-1)).
+    r.pipe_par = int(train_cfg.get("pipeline_parallelism", 1))
+    r.microbatches = int(train_cfg.get("microbatches", r.pipe_par))
+    if "microbatches" in train_cfg and r.pipe_par <= 1:
+        # silently ignoring the key would read as "microbatch streaming
+        # enabled" — grad_accumulation is the non-pipelined equivalent
+        raise ValueError(
+            "training.microbatches requires pipeline_parallelism > 1 "
+            "(use training.grad_accumulation for non-pipelined "
+            "micro-batching)"
+        )
+    if (r.seq_par > 1 or r.tensor_par > 1 or r.pipe_par > 1) and not r.is_lm:
+        raise ValueError(
+            "training.sequence_parallelism / tensor_parallelism / "
+            "pipeline_parallelism require model.name: TransformerLM"
+        )
+    if r.pipe_par > 1 and r.seq_par > 1 and r.tensor_par > 1:
+        # the pipeline mesh supports ONE inner axis besides stage:
+        # model (PP x TP) or sequence (PP x SP) — a 4-axis composition
+        # is not wired (parallel/pipeline.make_pp_mesh)
+        raise ValueError(
+            "pipeline_parallelism x sequence_parallelism x "
+            "tensor_parallelism (three-way) is not wired; pick "
+            "PP x SP or PP x TP"
+        )
+    # Additive key ``training.pp_schedule``: microbatch schedule for the
+    # pipeline step — "gpipe" (autodiff backward, O(M) activation
+    # residuals) or "1f1b" (manual interleaved backward with per-stage
+    # recompute, O(S) buffered microbatch inputs; engine/pp_steps.py).
+    r.pp_schedule = str(train_cfg.get("pp_schedule", "gpipe"))
+    if r.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"training.pp_schedule must be 'gpipe' or '1f1b', "
+            f"got {r.pp_schedule!r}"
+        )
+    if "pp_schedule" in train_cfg and r.pipe_par <= 1:
+        raise ValueError("training.pp_schedule requires pipeline_parallelism > 1")
+    if r.pipe_par > 1 and r.is_moe:
+        # MoE blocks break the homogeneous stacked-layer layout the
+        # pipeline step scans over, and its sown aux loss is discarded
+        # by the manual per-stage block apply
+        raise ValueError(
+            "model.moe_experts does not compose with pipeline_parallelism"
+        )
+    if r.is_moe and int(model_cfg.get("moe_experts")) % r.tensor_par != 0:
+        raise ValueError(
+            f"model.moe_experts ({model_cfg.get('moe_experts')}) must be "
+            f"divisible by training.tensor_parallelism ({r.tensor_par}) "
+            "for an even expert split"
+        )
+    if r.microbatches < max(r.pipe_par, 1):
+        raise ValueError(
+            f"training.microbatches ({r.microbatches}) must be >= "
+            f"pipeline_parallelism ({r.pipe_par})"
+        )
+    # Additive key ``training.zero``: ZeRO stage 0|1|2 (True = 1) —
+    # optimizer-state sharding over the data axis, stage 2 adds sharded
+    # gradient buffers (GSPMD LM path; parallel/tensor.py).  Parsed here
+    # because it changes BOTH the path selection and the model's
+    # attention mode.
+    zero_cfg = train_cfg.get("zero", False)
+    if isinstance(zero_cfg, bool):
+        r.zero = 1 if zero_cfg else 0  # True = ZeRO-1 (back-compat)
+    elif isinstance(zero_cfg, int) and zero_cfg in (0, 1, 2):
+        r.zero = zero_cfg
+    else:
+        raise ValueError(
+            f"training.zero must be a bool or a stage in (0, 1, 2), "
+            f"got {zero_cfg!r}"
+        )
+    if r.zero and not r.is_lm:
+        raise ValueError(
+            "training.zero is only wired for the LM task (GSPMD path)"
+        )
+    if r.zero >= 2 and r.pipe_par > 1:
+        # the pipeline step computes grads inside a manual shard_map with
+        # stage-sharded layouts — a different contract than ZeRO-2's
+        # data-axis gradient scatter (ZeRO-1 moments do compose there)
+        raise ValueError(
+            "training.zero: 2 does not compose with pipeline_parallelism "
+            "— use zero: 1 (sharded moments) under the pipeline"
+        )
+    if r.is_lm:
+        for key, par in (
+            ("sequence_parallelism", r.seq_par),
+            ("tensor_parallelism", r.tensor_par),
+            ("pipeline_parallelism", r.pipe_par),
+        ):
+            if par < 1 or jax.local_device_count() % par != 0:
+                # the host-batch layout (and
+                # make_array_from_process_local_data) assumes each host
+                # holds whole shard groups
+                raise ValueError(
+                    f"training.{key} ({par}) must divide the local "
+                    f"device count ({jax.local_device_count()})"
+                )
+        non_data_par = r.seq_par * r.tensor_par * r.pipe_par
+        if jax.local_device_count() % non_data_par != 0:
+            # combined: one data shard spans a seq x tensor x pipe
+            # device group — the whole group must fit within a host or
+            # units_local becomes 0 and the host batch degenerates
+            raise ValueError(
+                f"sequence_parallelism x tensor_parallelism x "
+                f"pipeline_parallelism ({r.seq_par} x {r.tensor_par}"
+                f" x {r.pipe_par}) must divide the local device count "
+                f"({jax.local_device_count()})"
+            )
+        sample_inp, _ = train_dataset[0]
+        r.seq_len = int(sample_inp.shape[0])
+        if r.seq_len % r.seq_par != 0:
+            raise ValueError(
+                f"dataset.seq_len ({r.seq_len}) must be divisible by "
+                f"training.sequence_parallelism ({r.seq_par})"
+            )
+        model_cfg.setdefault("max_len", r.seq_len)
+        if (
+            r.seq_par > 1
+            and r.tensor_par == 1
+            and r.pipe_par == 1
+            and not r.zero
+            and not r.is_moe
+        ):
+            # ring-attention path only; the GSPMD path (tensor_par or
+            # zero or MoE) keeps seq_axis=None and lets the partitioner
+            # distribute, and the PP x SP path builds its own
+            # seq_axis'd stage blocks (pp_steps._stage_applies) — a
+            # seq_axis model requires shard_map
+            model_cfg.setdefault("seq_axis", SEQUENCE_AXIS)
+        r.model = get_model(
+            model_name,
+            num_classes=cfg["dataset"]["n_classes"],
+            dtype=r.compute_dtype,
+            **model_cfg,
+        )
+        if r.is_moe and not (1 <= r.model.moe_every <= r.model.depth):
+            # read from the CONSTRUCTED model, not re-hardcoded class
+            # defaults (r2 review): moe_every 0 would div-by-zero at
+            # init; > depth silently trains a fully dense model while
+            # every MoE restriction still applies
+            raise ValueError(
+                f"model.moe_every ({r.model.moe_every}) must be in "
+                f"[1, depth={r.model.depth}] (moe_every > depth "
+                "would make no block MoE)"
+            )
+    else:
+        # reference behavior: only ``model.name`` is read for the image
+        # zoo — extra keys stay ignored (forwarding them would crash
+        # ResNet/ViT constructors on e.g. annotation-only keys)
+        r.model = get_model(
+            model_name,
+            num_classes=cfg["dataset"]["n_classes"],
+            axis_name=DATA_AXIS if r.sync_bn else None,
+            dtype=r.compute_dtype,
+        )
+
+
+def parse_batch(r, train_cfg: dict) -> int:
+    """Batch division + per-step micro-batching keys; returns the per-host
+    batch size.  Reference parity notes inline (train_distributed.py:194)."""
+    batch_size = train_cfg["batch_size"]
+    local_devices = jax.local_device_count()
+    # SURVEY §7 stage 4 decision, config-gated (additive key, unknown to
+    # the reference schema):
+    #   batch_division: local  — reference parity (:194): per-device batch
+    #       divides by the LOCAL device count, so the global batch scales
+    #       with node count (default).
+    #   batch_division: world  — divide by the WORLD device count, so cfg
+    #       batch_size IS the global batch at any topology.
+    division = train_cfg.get("batch_division", "local")
+    if division not in ("local", "world"):
+        raise ValueError(
+            f"training.batch_division must be 'local' or 'world', got {division!r}"
+        )
+    # Batch rows shard over the DATA axis only; each data shard spans a
+    # seq_par x tensor_par device group (either may be 1), so the
+    # division unit is a data shard, not a device.
+    non_data = r.seq_par * r.tensor_par * r.pipe_par if r.is_lm else 1
+    units_local = local_devices // non_data
+    units_world = r.world_size // non_data
+    # Additive key ``training.grad_accumulation``: per-step micro-batch
+    # count (lax.scan inside the compiled step — activation memory / N,
+    # identical update math; engine/steps.py).
+    r.grad_accum = int(train_cfg.get("grad_accumulation", 1))
+    if r.grad_accum < 1:
+        raise ValueError(f"grad_accumulation must be >= 1, got {r.grad_accum}")
+    if r.grad_accum > 1 and r.pipe_par > 1:
+        raise ValueError(
+            "grad_accumulation is redundant under pipeline_parallelism — "
+            "raise training.microbatches instead (same memory effect, "
+            "and it also shrinks the pipeline bubble)"
+        )
+    # Additive keys: torch-convention label smoothing + params EMA
+    # (evaluation runs with the EMA weights when enabled).
+    r.label_smoothing = float(train_cfg.get("label_smoothing", 0.0))
+    if not (0.0 <= r.label_smoothing < 1.0):
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {r.label_smoothing}"
+        )
+    ema_cfg = train_cfg.get("ema")
+    r.ema_decay = float(ema_cfg["decay"]) if ema_cfg else None
+    if r.ema_decay is not None and not (0.0 < r.ema_decay < 1.0):
+        raise ValueError(f"ema.decay must be in (0, 1), got {r.ema_decay}")
+    if r.ema_decay is not None and r.is_lm:
+        raise ValueError("training.ema is only wired for the image task")
+    if r.distributed:
+        divisor = units_world if division == "world" else units_local
+        per_device_batch = batch_size // max(divisor, 1)
+        if per_device_batch == 0 or divisor == 0:
+            raise ValueError(
+                f"batch_size {batch_size} < {division} batch-shard count {divisor}"
+            )
+        if division == "world" and batch_size % divisor != 0:
+            # the mode's whole contract is "cfg batch_size IS the global
+            # batch" — a silent floor would break it, so fail loudly
+            raise ValueError(
+                f"batch_division: world requires batch_size ({batch_size}) "
+                f"divisible by the world batch-shard count ({divisor})"
+            )
+        host_batch = per_device_batch * units_local
+    else:
+        host_batch = batch_size
+        per_device_batch = batch_size
+    if per_device_batch % r.grad_accum != 0:
+        # fail fast like every other config error, not at jit trace time
+        raise ValueError(
+            f"per-shard batch ({per_device_batch}) not divisible by "
+            f"training.grad_accumulation ({r.grad_accum})"
+        )
+    if r.pipe_par > 1 and per_device_batch % r.microbatches != 0:
+        raise ValueError(
+            f"per-shard batch ({per_device_batch}) not divisible by "
+            f"training.microbatches ({r.microbatches})"
+        )
+    return host_batch

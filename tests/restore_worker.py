@@ -50,13 +50,10 @@ def main():
         global_cfg=cfg, tb_writer_constructor=lambda: None,
     )
     runner()
-    flat = {
-        "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(leaf)
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-            runner.state.params
-        )[0]
-    }
-    np.savez(out_path, **flat)
+    sys.path.insert(0, os.path.join(_ROOT, "tests"))
+    from tree_utils import flat_tree
+
+    np.savez(out_path, **flat_tree(runner.state.params))
     meta = {
         "device_count": jax.device_count(),
         "restored_iter": int(runner.captured_iter),

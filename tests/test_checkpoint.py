@@ -346,14 +346,7 @@ def _setup_only(cfg):
     return runner
 
 
-def _flat(tree, materialize=True):
-    """Flatten to {path-string: leaf}; materialize=False keeps live arrays
-    (with their shardings) instead of host numpy copies."""
-    conv = np.asarray if materialize else (lambda x: x)
-    return {
-        "/".join(str(getattr(k, "key", k)) for k in path): conv(leaf)
-        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
-    }
+from tree_utils import flat_tree as _flat  # single source of the key format
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,14 @@
+"""Shared pytree helpers for tests (single source of the path-key format)."""
+import numpy as np
+
+import jax
+
+
+def flat_tree(tree, materialize=True):
+    """Flatten to {path-string: leaf}; materialize=False keeps live arrays
+    (with their shardings) instead of host numpy copies."""
+    conv = np.asarray if materialize else (lambda x: x)
+    return {
+        "/".join(str(getattr(k, "key", k)) for k in path): conv(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]
+    }
