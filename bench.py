@@ -52,8 +52,8 @@ def _enable_compile_cache():
     enable_compile_cache(setting or default)
 
 
-def _best_window_dt(run_one_window, iters: int) -> float:
-    """Best-of-N timing windows.
+def _best_window_dt(run_one_window, iters: int):
+    """Best-of-N timing windows; returns ``(min_time, median_time)``.
 
     The shared tunnel chip shows ±4-8% run-to-run variance (PERF.md); a
     single timing window samples that noise, so the scoreboard wandered
@@ -66,11 +66,12 @@ def _best_window_dt(run_one_window, iters: int) -> float:
     insurance is cheap next to the ~40s compile.)
     """
     windows = int(os.environ.get("BENCH_WINDOWS", "6"))
-    best = None
-    for _ in range(max(1, windows)):
-        dt = run_one_window(iters)
-        best = dt if best is None else min(best, dt)
-    return best
+    times = sorted(run_one_window(iters) for _ in range(max(1, windows)))
+    # median alongside min (ADVICE r3 #2): min is the scoreboard metric
+    # (achievable rate), median makes run variance visible in the record
+    n = len(times)
+    median = times[n // 2] if n % 2 else (times[n // 2 - 1] + times[n // 2]) / 2
+    return times[0], median
 
 
 def _make_jpeg_tree(root: str, n_images: int, size=(500, 375)) -> None:
@@ -327,7 +328,7 @@ def bench_lm():
     # 20-iter windows: amortizes the per-window tunnel sync to <2% at the
     # ~156ms LM step (see main()'s comment for the measured pathology)
     iters = int(os.environ.get("BENCH_ITERS", "20"))
-    dt = _best_window_dt(one_window, iters)
+    dt, dt_median = _best_window_dt(one_window, iters)
 
     tok_per_sec = batch * seq * iters / dt / jax.device_count()
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -355,11 +356,143 @@ def bench_lm():
                 "vs_baseline": None,
                 "device": kind,
                 "step_ms": round(dt / iters * 1e3, 1),
+                "median_step_ms": round(dt_median / iters * 1e3, 1),
                 "tflops_per_sec": round(fl_sec / 1e12, 1),
                 "mfu_pct": round(100 * fl_sec / peak, 1) if peak else None,
             }
         )
     )
+
+
+def bench_flash():
+    """Streamed/resident flash kernels vs naive XLA attention on real TPU.
+
+    Round-3 VERDICT weak #3: the tile-streaming kernels (the VMEM-ceiling
+    lift) only had interpreter-mode coverage.  This mode runs fwd+bwd for
+    each (seq, head-dim) config on the hardware, checks parity of the loss
+    and input gradients against the naive einsum path, and reports ms/op
+    for naive / resident / streamed.  One JSON line per config.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.ops.attention import (
+        dot_product_attention,
+    )
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    configs = [
+        # (seq, D, B, H): 2048/4096 at D=64 (the LM bench shapes); D=128 at
+        # 8192 (exactly AT the 8MB resident-K/V budget — the kernel's own
+        # dispatch still picks resident) and at 16384 (2*S*D*4 = 16MB,
+        # PAST the budget: tile streaming is the only flash path)
+        (2048, 64, 2, 8),
+        (4096, 64, 2, 8),
+        (8192, 128, 1, 4),
+        (16384, 128, 1, 2),
+    ]
+    iters = int(os.environ.get("BENCH_ITERS", "40"))
+
+    def timed(grad_fn, args):
+        """Device ms/op: ``iters`` fwd+bwd executions CHAINED inside one
+        compiled fori_loop (dq feeds the next q), one dispatch + one scalar
+        sync per window — per-call dispatch through the device transport
+        costs ~100s of ms and would otherwise swamp the kernel time."""
+
+        @jax.jit
+        def many(q, k, v):
+            def body(_, q_c):
+                _, (dq, dk, dv) = grad_fn(q_c, k, v)
+                # dk/dv folded into the carry so DCE cannot drop the
+                # dkv backward kernel from the measured program
+                return q_c + jnp.bfloat16(1e-3) * dq + jnp.bfloat16(1e-6) * (
+                    dk + dv
+                )
+            return jnp.float32(jax.lax.fori_loop(0, iters, body, q)).sum()
+
+        float(many(*args))  # compile + warm
+        best = None
+        for _ in range(int(os.environ.get("BENCH_WINDOWS", "3"))):
+            t0 = time.perf_counter()
+            float(many(*args))  # scalar materialization = hard sync
+            dt = (time.perf_counter() - t0) / iters
+            best = dt if best is None else min(best, dt)
+        # single un-chained call for the parity numbers
+        return best, grad_fn(*args)
+
+    for seq, d, b, h in configs:
+        rng = np.random.default_rng(0)
+        shape = (b, seq, h, d)
+        q, k, v = (
+            jnp.asarray(rng.standard_normal(shape, np.float32), jnp.bfloat16)
+            for _ in range(3)
+        )
+
+        def loss_of(attn):
+            def f(q, k, v):
+                o = attn(q, k, v)
+                return (o.astype(jnp.float32) ** 2).mean()
+
+            return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+
+        def naive(q, k, v):
+            return dot_product_attention(q, k, v, causal=True, impl="xla")
+
+        def resident(q, k, v):
+            return flash_attention(q, k, v, causal=True)
+
+        def streamed(q, k, v):
+            prev = os.environ.get("PDT_FLASH_FORCE_STREAM")
+            os.environ["PDT_FLASH_FORCE_STREAM"] = "1"
+            try:
+                return flash_attention(q, k, v, causal=True)
+            finally:
+                # restore, don't pop: a user-level PDT_FLASH_FORCE_STREAM=1
+                # must survive this wrapper
+                if prev is None:
+                    os.environ.pop("PDT_FLASH_FORCE_STREAM", None)
+                else:
+                    os.environ["PDT_FLASH_FORCE_STREAM"] = prev
+
+        dt_naive, (l_naive, g_naive) = timed(loss_of(naive), (q, k, v))
+        dt_stream, (l_stream, g_stream) = timed(loss_of(streamed), (q, k, v))
+        # mirror the kernel's own dispatch gate so "resident" here means
+        # exactly what un-forced flash_attention would run
+        from pytorch_distributed_training_tpu.ops.flash_attention import (
+            _resident_ok,
+        )
+
+        resident_fits = _resident_ok(seq, d)
+        dt_res = None
+        if resident_fits:
+            dt_res, _ = timed(loss_of(resident), (q, k, v))
+
+        # parity vs naive: loss + max input-grad deviation (bf16 tolerances)
+        loss_err = abs(float(l_stream) - float(l_naive))
+        grad_err = max(
+            float(jnp.max(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32))))
+            for a, b_ in zip(g_stream, g_naive)
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"flash-attention fwd+bwd S={seq} D={d} "
+                    f"(B={b}, H={h}, bf16, causal)",
+                    "value": round(dt_stream * 1e3, 2),
+                    "unit": "ms/op (streamed)",
+                    "vs_baseline": None,
+                    "naive_ms": round(dt_naive * 1e3, 2),
+                    "resident_ms": round(dt_res * 1e3, 2) if dt_res else None,
+                    "streamed_vs_naive_speedup": round(dt_naive / dt_stream, 2),
+                    "loss_abs_err_vs_naive": round(loss_err, 6),
+                    "grad_max_abs_err_vs_naive": round(grad_err, 5),
+                    "device": jax.devices()[0].device_kind,
+                }
+            )
+        )
 
 
 def main():
@@ -430,7 +563,7 @@ def main():
     # the amortized overhead below 1%: measured 2640 img/s/chip vs 2498 with
     # 20-iter windows on the same chip, same program.
     iters = int(os.environ.get("BENCH_ITERS", "60"))
-    dt = _best_window_dt(one_window, iters)
+    dt, dt_median = _best_window_dt(one_window, iters)
 
     img_per_sec_chip = batch * iters / dt / n_chips
     # MFU estimate: ResNet-50 fwd ~4.1 GFLOP/img @224, training ~3x fwd.
@@ -453,6 +586,7 @@ def main():
                 "vs_baseline": round(img_per_sec_chip / A100_DDP_IMG_PER_SEC, 3),
                 "device": kind,
                 "step_ms": round(step_ms, 1),
+                "median_step_ms": round(dt_median / iters * 1e3, 1),
                 "tflops_per_sec": round(flops_per_sec / 1e12, 1),
                 "mfu_pct": round(100 * flops_per_sec / peak, 1) if peak else None,
             }
@@ -469,6 +603,38 @@ if __name__ == "__main__":
         bench_e2e()
     elif mode == "lm":
         bench_lm()
+    elif mode == "flash":
+        bench_flash()
+    elif mode == "accuracy":
+        # Converged-accuracy parity (round-3 VERDICT #1): train ResNet-18
+        # through this framework's compiled step AND through a torch
+        # reference-semantics script on byte-identical augmented JPEG
+        # streams from a shared init; print both top-1 numbers.  Heavy
+        # (~1h: the torch side runs on this host's CPU) — on-demand, not
+        # part of the driver's default bench run.  See accuracy_harness.py.
+        import accuracy_harness
+
+        iters = int(os.environ.get("BENCH_ACCURACY_ITERS", "2000"))
+        out = accuracy_harness.run_all(
+            os.environ.get("BENCH_ACCURACY_DIR", ".accuracy"), iters,
+            eval_every=int(os.environ.get("BENCH_ACCURACY_EVAL", "500")),
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "ResNet-18 converged val top-1: this framework "
+                    f"vs torch (byte-identical data, {iters} iters)",
+                    "value": out["ours_top1"],
+                    "unit": "percent",
+                    "vs_baseline": (
+                        round(out["ours_top1"] / out["torch_top1"], 4)
+                        if out.get("torch_top1")
+                        else None
+                    ),
+                    **out,
+                }
+            )
+        )
     else:
         # Default driver-scored run: emit the LM tokens/sec line FIRST so the
         # recorded tail carries both numbers, then the ResNet line LAST (the

@@ -193,3 +193,126 @@ def test_trajectory_canary_catches_wrong_recipe(wrong):
     with pytest.raises(AssertionError):
         np.testing.assert_allclose(jax_losses[:3], torch_losses[:3], rtol=1e-4)
         np.testing.assert_allclose(jax_losses, torch_losses, rtol=2e-2)
+
+
+# ----------------------------------------------------------------------
+# Long-horizon statistical parity (round-3 VERDICT #1, second half).
+# Lockstep bounds cannot survive ~1k chaotic steps (the Lyapunov growth
+# measured above); the long-horizon oracle is STATISTICAL: from the same
+# torch-ported init on the same batch stream, the bf16 compiled step and
+# torch f32 must converge to the same place — final-window training loss
+# within a band, probe accuracy within a few points, and both far below
+# the initial loss.  (The real-JPEG converged-accuracy comparison lives in
+# accuracy_harness.py / PERF.md; this is its fast synthetic pin.)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_long_horizon_bf16_statistical_parity():
+    iters, batch = 400, 16
+    milestone = [280]
+    lr0 = 0.01
+    rng = np.random.default_rng(11)
+    class_means = rng.standard_normal((CLASSES, 3)).astype(np.float32)
+    labels = rng.integers(0, CLASSES, (iters, batch)).astype(np.int32)
+    imgs = (
+        class_means[labels].reshape(iters, batch, 1, 1, 3)
+        + 0.5 * rng.standard_normal((iters, batch, SIZE, SIZE, 3))
+    ).astype(np.float32)
+    # held-out probe: 256 samples (accuracy granularity 0.4pt; a single
+    # 16-sample batch would quantize to 6.25pt steps)
+    n_probe = 256
+    probe_lab = rng.integers(0, CLASSES, (n_probe,)).astype(np.int32)
+    probe_img = (
+        class_means[probe_lab].reshape(n_probe, 1, 1, 3)
+        + 0.5 * rng.standard_normal((n_probe, SIZE, SIZE, 3))
+    ).astype(np.float32)
+
+    # --- torch f32 ----------------------------------------------------
+    torch.manual_seed(0)
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=CLASSES)
+    topt = torch.optim.SGD(
+        tmodel.parameters(), lr=lr0, momentum=MOMENTUM, weight_decay=WD
+    )
+    tsched = torch.optim.lr_scheduler.MultiStepLR(topt, milestone, GAMMA)
+    loss_fn = torch.nn.CrossEntropyLoss()
+    tmodel.train()
+    t_losses = []
+    for i in range(iters):
+        x = torch.from_numpy(np.transpose(imgs[i], (0, 3, 1, 2))).contiguous()
+        y = torch.from_numpy(labels[i]).long()
+        topt.zero_grad()
+        loss = loss_fn(tmodel(x), y)
+        loss.backward()
+        topt.step()
+        tsched.step()
+        t_losses.append(float(loss.detach()))
+    tmodel.eval()
+    with torch.no_grad():
+        t_acc = float(
+            (
+                tmodel(
+                    torch.from_numpy(np.transpose(probe_img, (0, 3, 1, 2)))
+                ).argmax(1).numpy()
+                == probe_lab
+            ).mean()
+        ) * 100
+
+    # --- ours, bf16 compute (f32 params/BN stats) ---------------------
+    torch.manual_seed(0)
+    tw = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=CLASSES)
+    opt = SGD(lr=lr0, momentum=MOMENTUM, weight_decay=WD)
+    model = get_model("ResNet18", num_classes=CLASSES, dtype=jnp.bfloat16)
+    state = init_train_state(
+        model, opt, jax.random.PRNGKey(0), jnp.zeros((1, SIZE, SIZE, 3))
+    )
+    variables = import_torch_resnet_state_dict(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        tw.state_dict(),
+    )
+    state = state.replace(
+        params=jax.tree.map(jnp.asarray, variables["params"]),
+        batch_stats=jax.tree.map(jnp.asarray, variables["batch_stats"]),
+    )
+    mesh = make_mesh(devices=jax.devices()[:1])
+    state = jax.device_put(state, replicated_sharding(mesh))
+    step = build_train_step(
+        model, opt, multi_step_lr(lr0, milestone, GAMMA), mesh,
+        sync_bn=False, donate=False,
+    )
+    j_losses = []
+    for i in range(iters):
+        img = jax.device_put(imgs[i], batch_sharding(mesh, 4))
+        lab = jax.device_put(labels[i], batch_sharding(mesh, 1))
+        state, loss = step(state, img, lab)
+        j_losses.append(float(loss))
+    from pytorch_distributed_training_tpu.engine import build_eval_step
+
+    eval_step = build_eval_step(model, mesh)
+    _, j_acc, _ = eval_step(
+        state,
+        jax.device_put(probe_img, batch_sharding(mesh, 4)),
+        jax.device_put(probe_lab, batch_sharding(mesh, 1)),
+    )
+    j_acc = float(j_acc)
+
+    # Statistical agreement via ROBUST statistics: per-step losses at
+    # convergence are spiky (individual steps span 0.003..1.9 on this
+    # recipe), so window MEANS are dominated by a few spikes and genuinely
+    # differ 30-60% between the bf16 and f32 runs even when both are
+    # converged (two calibration runs measured mean gaps of 26% and 58%
+    # while probe accuracies agreed to a few points).  The pinned claims:
+    # (1) both trajectories CONVERGE — tail median far below the initial
+    # loss; (2) the converged models CLASSIFY the same — held-out probe
+    # accuracy within 10 points.  A broken bf16 step, dropped momentum, or
+    # an ignored milestone fails (1) or (2) by a wide margin; the
+    # short-window canaries above pin exact-recipe drift.
+    t_med = float(np.median(t_losses[-80:]))
+    j_med = float(np.median(j_losses[-80:]))
+    init_loss = t_losses[0]
+    assert t_med < 0.25 * init_loss, f"torch did not converge: {t_med}"
+    assert j_med < 0.25 * init_loss, (
+        f"bf16 step did not converge: tail median {j_med} vs torch {t_med} "
+        f"(init {init_loss})"
+    )
+    assert abs(j_acc - t_acc) <= 10.0, (
+        f"probe accuracy gap: ours(bf16) {j_acc:.1f}% vs torch {t_acc:.1f}%"
+    )
