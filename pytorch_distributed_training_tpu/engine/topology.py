@@ -223,12 +223,37 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
     else:
         # reference behavior: only ``model.name`` is read for the image
         # zoo — extra keys stay ignored (forwarding them would crash
-        # ResNet/ViT constructors on e.g. annotation-only keys)
+        # ResNet/ViT constructors on e.g. annotation-only keys).  One
+        # sanctioned additive key: ``model.space_to_depth`` (the MLPerf
+        # packed stem, ResNet family only; models/resnet.py).
+        s2d = bool(model_cfg.get("space_to_depth", False))
+        bn_stat = model_cfg.get("bn_stat_dtype")
+        if bn_stat is not None and bn_stat not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"model.bn_stat_dtype must be 'float32' or 'bfloat16', "
+                f"got {bn_stat!r}"
+            )
+        if s2d or bn_stat:
+            from ..models.resnet import RESNET_CONFIGS
+
+            if model_name.lower() not in {k.lower() for k in RESNET_CONFIGS}:
+                raise ValueError(
+                    f"model.space_to_depth / bn_stat_dtype are only wired "
+                    f"for the ResNet family (got model.name: {model_name})"
+                )
+        extra = {}
+        if s2d:
+            extra["space_to_depth"] = True
+        if bn_stat:
+            extra["bn_stat_dtype"] = {
+                "float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            }[bn_stat]
         r.model = get_model(
             model_name,
             num_classes=cfg["dataset"]["n_classes"],
             axis_name=DATA_AXIS if r.sync_bn else None,
             dtype=r.compute_dtype,
+            **extra,
         )
 
 

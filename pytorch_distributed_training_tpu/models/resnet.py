@@ -38,10 +38,51 @@ __all__ = [
     "BasicBlock",
     "Bottleneck",
     "RESNET_CONFIGS",
+    "fold_stem_kernel",
 ]
 
 # torch kaiming_normal_(mode="fan_out", nonlinearity="relu")
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+def fold_stem_kernel(w7):
+    """Fold a 7x7/2 stem kernel [7,7,C,O] into the space-to-depth
+    equivalent [4,4,4C,O] (4x4 stride-1 conv over the 2x2-packed input).
+
+    Exact algebra: the 7x7 stride-2 conv reads ``x[2i+a-3]``; with the 2x2
+    pack ``z[p,(u,c)] = x[2p+u]`` each tap ``a`` lands at packed offset
+    ``m-2 = (a-3-u)//2`` with parity ``u = (a-3) % 2`` — 4 packed taps per
+    axis, one (m=0, u=0) slot left zero.  The zero slots also make the
+    padding equivalence exact: the packed conv's ((2,1),(2,1)) pad reaches
+    one original pixel beyond the 7x7 conv's pad-3, but only through
+    zero-weight slots.  Used by the model's from-scratch init (fold a
+    kaiming 7x7 draw, keeping the init distribution identical) and by the
+    torchvision weight port (models/torch_port.py).
+    """
+    kh, kw, c, o = w7.shape
+    assert (kh, kw) == (7, 7), w7.shape
+    # jnp (not numpy) so the fold is traceable — the from-scratch init runs
+    # under jit; numpy callers get a concrete jnp array back
+    w7 = jnp.asarray(w7)
+    out = jnp.zeros((4, 4, 4 * c, o), dtype=w7.dtype)
+    for a in range(7):
+        u = (a - 3) % 2
+        m = (a - 3 - u) // 2 + 2
+        for b in range(7):
+            v = (b - 3) % 2
+            n = (b - 3 - v) // 2 + 2
+            out = out.at[m, n, (u * 2 + v) * c:(u * 2 + v) * c + c, :].set(
+                w7[a, b]
+            )
+    return out
+
+
+def _s2d_stem_init(key, shape, dtype):
+    """Init the packed stem by folding a kaiming 7x7 draw — the from-scratch
+    weight DISTRIBUTION matches the standard stem exactly."""
+    _, _, c4, o = shape
+    w7 = conv_kernel_init(key, (7, 7, c4 // 4, o), dtype)
+    return jnp.asarray(fold_stem_kernel(w7), dtype)
 
 
 def _torch_linear_kernel_init(key, shape, dtype):
@@ -133,18 +174,27 @@ class ResNet(nn.Module):
     num_classes: int
     axis_name: Optional[str] = None
     dtype: Any = jnp.float32
+    # MLPerf-style stem: 2x2 space-to-depth pack + folded 4x4/1 conv,
+    # numerically EQUAL to the 7x7/2 stem (fold_stem_kernel) but far
+    # friendlier to the MXU (C_in 12 instead of 3, half the spatial grid).
+    # Config key ``model.space_to_depth``; torchvision checkpoints port
+    # through the same fold, so accuracy parity oracles stay pinned.
+    space_to_depth: bool = False
+    # Config key ``model.bn_stat_dtype``: batch-moment accumulation dtype
+    # (ops/batch_norm.py stat_dtype); None = f32 torch-parity default.
+    bn_stat_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        def conv(features, kernel, stride, name):
-            pad = [(k // 2, k // 2) for k in kernel]
+        def conv(features, kernel, stride, name, padding=None, kernel_init=None):
+            pad = padding or [(k // 2, k // 2) for k in kernel]
             return nn.Conv(
                 features,
                 kernel,
                 strides=(stride, stride),
                 padding=pad,
                 use_bias=False,
-                kernel_init=conv_kernel_init,
+                kernel_init=kernel_init or conv_kernel_init,
                 dtype=self.dtype,
                 param_dtype=jnp.float32,
                 name=name,
@@ -157,10 +207,25 @@ class ResNet(nn.Module):
             momentum=0.1,
             epsilon=1e-5,
             dtype=self.dtype,
+            stat_dtype=self.bn_stat_dtype,
         )
 
         x = x.astype(self.dtype)
-        x = conv(64, (7, 7), 2, name="conv1")(x)
+        if self.space_to_depth:
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth requires even input dims, got {h}x{w}"
+                )
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            # packed taps span offsets -2..+1 (see fold_stem_kernel)
+            x = conv(
+                64, (4, 4), 1, name="conv1",
+                padding=((2, 1), (2, 1)), kernel_init=_s2d_stem_init,
+            )(x)
+        else:
+            x = conv(64, (7, 7), 2, name="conv1")(x)
         x = norm(name="bn1")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))

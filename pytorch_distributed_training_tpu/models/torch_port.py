@@ -123,6 +123,16 @@ def import_torch_resnet_state_dict(
         arr = _to_numpy(state_dict[key])
         if transform == "conv":
             arr = np.transpose(arr, (2, 3, 1, 0))  # OIHW -> HWIO
+            if (
+                arr.shape[:2] == (7, 7)
+                and tuple(np.shape(leaf))
+                == (4, 4, 4 * arr.shape[2], arr.shape[3])
+            ):
+                # space-to-depth stem: fold the 7x7/2 kernel into the
+                # exact packed 4x4/1 equivalent (models/resnet.py)
+                from .resnet import fold_stem_kernel
+
+                arr = fold_stem_kernel(arr)
         elif transform == "linear":
             arr = arr.T  # (out, in) -> (in, out)
         if tuple(arr.shape) != tuple(np.shape(leaf)):

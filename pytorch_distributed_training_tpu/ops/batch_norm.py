@@ -23,8 +23,11 @@ biased/unbiased choice silently costs top-1):
     SyncBatchNorm's allreduce; without sync, DDP broadcast_buffers papers
     over drift — see engine notes).
 
-Stats are always computed in float32 even for bf16 activations (torch
+Stats are computed in float32 even for bf16 activations by default (torch
 autocast keeps BN in fp32; also required for variance accuracy on TPU).
+The opt-in ``stat_dtype`` field (config ``model.bn_stat_dtype``) lowers the
+batch-moment + normalize math to bf16 — running stats stay f32; a measured
+throughput-neutral, accuracy-hazardous experiment (PERF.md round 4).
 """
 from __future__ import annotations
 
@@ -55,6 +58,14 @@ class DistributedBatchNorm(nn.Module):
     epsilon: float = 1e-5
     dtype: Optional[jnp.dtype] = None
     param_dtype: jnp.dtype = jnp.float32
+    # Batch-stat accumulation dtype (config ``model.bn_stat_dtype``):
+    # None/f32 = torch-parity default.  bf16 computes the batch moments and
+    # the normalize in bf16 (running stats STAY f32) — the PERF.md lever
+    # experiment; measured throughput-neutral on the bench chip (the
+    # normalize was already a bf16-in/bf16-out fusion with in-register f32
+    # math) and a known accuracy hazard (bf16's 8 mantissa bits cancel in
+    # the variance), so it is off unless explicitly requested.
+    stat_dtype: Optional[jnp.dtype] = None
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -71,7 +82,8 @@ class DistributedBatchNorm(nn.Module):
             "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
         )
 
-        xf = x.astype(jnp.float32)
+        stat_dtype = self.stat_dtype or jnp.float32
+        xf = x.astype(stat_dtype)
         reduce_axes = tuple(range(x.ndim - 1))
 
         if use_ra:
@@ -102,10 +114,14 @@ class DistributedBatchNorm(nn.Module):
                 # HBM cost: x is still read once for stats, which is what
                 # keeps the bandwidth-bound ResNet step at its measured
                 # throughput (PERF.md).
-                c = jax.lax.stop_gradient(ra_mean.value)
+                c = jax.lax.stop_gradient(ra_mean.value).astype(stat_dtype)
                 var = jnp.mean(
                     jnp.square(xf - c), axis=reduce_axes
                 ) - jnp.square(mean - c)
+            if stat_dtype != jnp.float32:
+                # low-precision moment cancellation can round var below 0,
+                # which would NaN the rsqrt
+                var = jnp.maximum(var, 0.0)
 
             if not self.is_initializing() and self.is_mutable_collection("batch_stats"):
                 unbiased = var * (n / max(n - 1, 1))
@@ -113,7 +129,9 @@ class DistributedBatchNorm(nn.Module):
                 ra_mean.value = (1.0 - m) * ra_mean.value + m * mean
                 ra_var.value = (1.0 - m) * ra_var.value + m * unbiased
 
-        inv = jax.lax.rsqrt(var + self.epsilon)
-        y = (xf - mean) * inv * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        inv = jax.lax.rsqrt(var.astype(stat_dtype) + stat_dtype(self.epsilon))
+        y = (xf - mean.astype(stat_dtype)) * inv * scale.astype(
+            stat_dtype
+        ) + bias.astype(stat_dtype)
         out_dtype = self.dtype or x.dtype
         return y.astype(out_dtype)

@@ -1,0 +1,179 @@
+"""Space-to-depth stem (model.space_to_depth): exact equivalence + wiring.
+
+The MLPerf ResNet trick (models/resnet.py): 2x2-pack the input and replace
+the 7x7/2 stem with a folded 4x4/1 conv.  The fold is exact algebra, so the
+oracle is strong: the SAME torch checkpoint ported into the standard and
+the packed model must produce equal logits.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from test_torch_port import TorchBasicBlock, TorchResNet, _randomize_running_stats
+
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.models.resnet import fold_stem_kernel
+from pytorch_distributed_training_tpu.models.torch_port import (
+    import_torch_resnet_state_dict,
+)
+
+
+def test_folded_stem_matches_7x7_conv():
+    """Direct algebra check: folded 4x4/1 conv over packed input == 7x7/2
+    conv, including the boundary (padding) rows."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 32, 32, 3)).astype(np.float32)
+    w7 = rng.standard_normal((7, 7, 3, 8)).astype(np.float32)
+
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w7), window_strides=(2, 2),
+        padding=((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    b, h, w, c = x.shape
+    z = x.reshape(b, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    z = z.reshape(b, h // 2, w // 2, 4 * c)
+    out = jax.lax.conv_general_dilated(
+        jnp.asarray(z), jnp.asarray(fold_stem_kernel(w7)),
+        window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_s2d_resnet_matches_standard_from_same_checkpoint():
+    """Port ONE torch ResNet-18 into both stems: logits must agree."""
+    torch.manual_seed(0)
+    tmodel = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=10)
+    _randomize_running_stats(tmodel, seed=1)
+    sd = tmodel.state_dict()
+
+    rng = np.random.default_rng(2)
+    img = jnp.asarray(rng.standard_normal((4, 64, 64, 3)).astype(np.float32))
+
+    outs = {}
+    for s2d in (False, True):
+        model = get_model("ResNet18", num_classes=10, space_to_depth=s2d)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+        if s2d:
+            assert variables["params"]["conv1"]["kernel"].shape == (4, 4, 12, 64)
+        variables = import_torch_resnet_state_dict(variables, sd)
+        outs[s2d] = np.asarray(
+            model.apply(
+                {"params": variables["params"],
+                 "batch_stats": variables["batch_stats"]},
+                img, train=False,
+            )
+        )
+    np.testing.assert_allclose(outs[True], outs[False], atol=1e-4, rtol=1e-4)
+
+
+def test_s2d_init_folds_kaiming_draw():
+    """From-scratch init: the packed kernel is a fold of a 7x7 kaiming draw
+    (one all-zero slot per axis pair; matching total variance)."""
+    model = get_model("ResNet18", num_classes=10, space_to_depth=True)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))[
+        "params"
+    ]
+    k = np.asarray(params["conv1"]["kernel"])
+    assert k.shape == (4, 4, 12, 64)
+    # the (m=0, u=0) slots are structurally zero (only a=0/u=1 reaches m=0)
+    assert np.all(k[0, :, 0:3] == 0) or np.all(k[0, :, 6:9] == 0)
+    # 49 of 64 packed taps carry weight; nonzero count per output channel
+    nonzero = np.count_nonzero(np.abs(k[..., 0]) > 0)
+    assert nonzero == 49 * 3
+
+
+def test_s2d_config_wiring(tmp_path):
+    """Runner trains end-to-end with model.space_to_depth; ViT rejected."""
+    from pytorch_distributed_training_tpu.engine import Runner
+
+    def cfg(name, s2d=True):
+        return {
+            "dataset": {
+                "name": "synthetic", "root": str(tmp_path), "n_classes": 4,
+                "image_size": 32, "n_samples": 64,
+            },
+            "training": {
+                "optimizer": {
+                    "name": "SGD", "lr": 0.05, "weight_decay": 1.0e-4,
+                    "momentum": 0.9,
+                },
+                "lr_schedule": {"name": "multi_step", "milestones": [4],
+                                "gamma": 0.1},
+                "train_iters": 2,
+                "print_interval": 1,
+                "val_interval": 2,
+                "batch_size": 16,
+                "num_workers": 2,
+                "sync_bn": False,
+            },
+            "validation": {"batch_size": 16, "num_workers": 2},
+            "model": {"name": name, "space_to_depth": s2d},
+        }
+
+    def run(c):
+        runner = Runner(
+            num_nodes=1, rank=0, seed=5, dist_url="tcp://127.0.0.1:9919",
+            dist_backend="tpu", multiprocessing=False, logger_queue=None,
+            global_cfg=c, tb_writer_constructor=lambda: None,
+        )
+        runner()
+        return runner
+
+    r = run(cfg("ResNet18"))
+    assert r.iter == 2
+    assert r.state.params["conv1"]["kernel"].shape == (4, 4, 12, 64)
+
+    with pytest.raises(ValueError, match="ResNet family"):
+        run(cfg("ViT-Ti16"))
+
+
+def test_bn_stat_dtype_config(tmp_path):
+    """model.bn_stat_dtype: bfloat16 trains end-to-end; bad values raise."""
+    from pytorch_distributed_training_tpu.engine import Runner
+
+    def cfg(**model_extra):
+        return {
+            "dataset": {
+                "name": "synthetic", "root": str(tmp_path), "n_classes": 4,
+                "image_size": 32, "n_samples": 64,
+            },
+            "training": {
+                "optimizer": {
+                    "name": "SGD", "lr": 0.05, "weight_decay": 1.0e-4,
+                    "momentum": 0.9,
+                },
+                "lr_schedule": {"name": "multi_step", "milestones": [4],
+                                "gamma": 0.1},
+                "train_iters": 2,
+                "print_interval": 1,
+                "val_interval": 2,
+                "batch_size": 16,
+                "num_workers": 2,
+                "sync_bn": False,
+                "dtype": "bfloat16",
+            },
+            "validation": {"batch_size": 16, "num_workers": 2},
+            "model": {"name": "ResNet18", **model_extra},
+        }
+
+    def run(c):
+        runner = Runner(
+            num_nodes=1, rank=0, seed=5, dist_url="tcp://127.0.0.1:9921",
+            dist_backend="tpu", multiprocessing=False, logger_queue=None,
+            global_cfg=c, tb_writer_constructor=lambda: None,
+        )
+        runner()
+        return runner
+
+    r = run(cfg(bn_stat_dtype="bfloat16"))
+    assert r.iter == 2
+    # running stats stay f32 regardless of the stat dtype
+    assert r.state.batch_stats["bn1"]["mean"].dtype == jnp.float32
+
+    with pytest.raises(ValueError, match="bn_stat_dtype must be"):
+        run(cfg(bn_stat_dtype="float16"))
