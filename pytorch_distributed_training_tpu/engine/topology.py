@@ -71,6 +71,24 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
             "(no torch-twin layout for expert weights)"
         )
     r.sync_bn = bool(train_cfg["sync_bn"]) and r.distributed and not r.is_lm
+    # ResNet-only model keys, validated BEFORE the LM/image split so an LM
+    # config with either key gets the curated error, not a raw constructor
+    # TypeError (tests/test_space_to_depth.py pins the messages).
+    s2d = bool(model_cfg.pop("space_to_depth", False))
+    bn_stat = model_cfg.pop("bn_stat_dtype", None)
+    if bn_stat is not None and bn_stat not in ("float32", "bfloat16"):
+        raise ValueError(
+            f"model.bn_stat_dtype must be 'float32' or 'bfloat16', "
+            f"got {bn_stat!r}"
+        )
+    if s2d or bn_stat:
+        from ..models.resnet import RESNET_CONFIGS
+
+        if model_name.lower() not in {k.lower() for k in RESNET_CONFIGS}:
+            raise ValueError(
+                f"model.space_to_depth / bn_stat_dtype are only wired "
+                f"for the ResNet family (got model.name: {model_name})"
+            )
     r.seq_par = int(train_cfg.get("sequence_parallelism", 1))
     r.tensor_par = int(train_cfg.get("tensor_parallelism", 1))
     # Additive key ``training.pipeline_parallelism``: GPipe microbatch
@@ -223,24 +241,9 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
     else:
         # reference behavior: only ``model.name`` is read for the image
         # zoo — extra keys stay ignored (forwarding them would crash
-        # ResNet/ViT constructors on e.g. annotation-only keys).  One
-        # sanctioned additive key: ``model.space_to_depth`` (the MLPerf
-        # packed stem, ResNet family only; models/resnet.py).
-        s2d = bool(model_cfg.get("space_to_depth", False))
-        bn_stat = model_cfg.get("bn_stat_dtype")
-        if bn_stat is not None and bn_stat not in ("float32", "bfloat16"):
-            raise ValueError(
-                f"model.bn_stat_dtype must be 'float32' or 'bfloat16', "
-                f"got {bn_stat!r}"
-            )
-        if s2d or bn_stat:
-            from ..models.resnet import RESNET_CONFIGS
-
-            if model_name.lower() not in {k.lower() for k in RESNET_CONFIGS}:
-                raise ValueError(
-                    f"model.space_to_depth / bn_stat_dtype are only wired "
-                    f"for the ResNet family (got model.name: {model_name})"
-                )
+        # ResNet/ViT constructors on e.g. annotation-only keys).  Two
+        # sanctioned additive keys (validated above, before the LM split):
+        # ``model.space_to_depth`` and ``model.bn_stat_dtype``.
         extra = {}
         if s2d:
             extra["space_to_depth"] = True

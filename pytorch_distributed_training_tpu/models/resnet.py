@@ -59,21 +59,32 @@ def fold_stem_kernel(w7):
     kaiming 7x7 draw, keeping the init distribution identical) and by the
     torchvision weight port (models/torch_port.py).
     """
+    import numpy as np
+
+    import jax
+
     kh, kw, c, o = w7.shape
     assert (kh, kw) == (7, 7), w7.shape
-    # jnp (not numpy) so the fold is traceable — the from-scratch init runs
-    # under jit; numpy callers get a concrete jnp array back
-    w7 = jnp.asarray(w7)
-    out = jnp.zeros((4, 4, 4 * c, o), dtype=w7.dtype)
+    # numpy for concrete kernels (checkpoint import, eager init — 49 eager
+    # device ops would cost seconds per dispatch on remote-device infra);
+    # jnp .at[].set() only when tracing (the init can run under jit)
+    traced = isinstance(w7, jax.core.Tracer)
+    if traced:
+        out = jnp.zeros((4, 4, 4 * c, o), dtype=w7.dtype)
+    else:
+        w7 = np.asarray(w7)
+        out = np.zeros((4, 4, 4 * c, o), dtype=w7.dtype)
     for a in range(7):
         u = (a - 3) % 2
         m = (a - 3 - u) // 2 + 2
         for b in range(7):
             v = (b - 3) % 2
             n = (b - 3 - v) // 2 + 2
-            out = out.at[m, n, (u * 2 + v) * c:(u * 2 + v) * c + c, :].set(
-                w7[a, b]
-            )
+            sl = slice((u * 2 + v) * c, (u * 2 + v) * c + c)
+            if traced:
+                out = out.at[m, n, sl, :].set(w7[a, b])
+            else:
+                out[m, n, sl, :] = w7[a, b]
     return out
 
 

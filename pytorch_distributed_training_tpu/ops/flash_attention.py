@@ -54,8 +54,14 @@ _NEG = -1e30  # finite mask value; see module docstring
 # a 1.57x kernel speedup from fewer grid steps and larger MXU feeds.
 # ``_blocks`` halves them until they divide the sequence, so any
 # 128-multiple (and tiny interpreter-test shapes) still works.
-_BLOCK_Q = 256
-_BLOCK_K = 512
+# Round-4 sweep on the bench chip at the LM bench attention shape
+# (B4 H16 S2048 D64, fwd+bwd, chained timing): 256/512 6.40ms (the round-2
+# default), 512/512 5.92, 512/1024 5.15, 1024/512 5.21, **1024/1024
+# 5.12ms** — 1.25x; 2048-row tiles exceed VMEM.  Larger tiles win because
+# D=64 underfills the MXU contraction, so per-tile overheads (grid steps,
+# m/l bookkeeping) amortize over more rows.
+_BLOCK_Q = 1024
+_BLOCK_K = 1024
 # VMEM budget for the RESIDENT kernels' K/V rows (f32): each instance holds
 # 2 full [S, D] f32 operands plus tiles/accumulators; stay well under the
 # ~16MB scoped VMEM.  Sequences past this budget no longer fall back to the
