@@ -97,11 +97,23 @@ class DistributedBatchNorm(nn.Module):
             if self.axis_name is not None:
                 # Cross-replica sync: one fused pmean for (mean, E[x^2]) —
                 # the same single-pass moments torch.nn.SyncBatchNorm
-                # allreduces, so the sync path matches torch's sync path.
-                mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+                # allreduces, so the f32 sync path matches torch's sync path
+                # BIT for bit.  Under low-precision stats (already a
+                # deliberate parity departure) the shift used by the local
+                # path is applied here too — it commutes with pmean, keeps
+                # the single all-reduce, and avoids the E[x^2]-mean^2
+                # cancellation that bf16's 8 mantissa bits cannot survive
+                # (ADVICE r3 #4).
+                if stat_dtype == jnp.float32:
+                    c = None  # raw moments: bitwise torch SyncBatchNorm
+                    mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+                else:
+                    c = jax.lax.stop_gradient(ra_mean.value).astype(stat_dtype)
+                    mean_sq = jnp.mean(jnp.square(xf - c), axis=reduce_axes)
                 mean, mean_sq = jax.lax.pmean((mean, mean_sq), self.axis_name)
                 n = local_n * jax.lax.psum(1, self.axis_name)
-                var = mean_sq - jnp.square(mean)  # biased: for normalization
+                # biased variance, for normalization
+                var = mean_sq - jnp.square(mean if c is None else mean - c)
             else:
                 # Local stats: SHIFTED single-pass moments,
                 # ``var = E[(x-c)^2] - (mean-c)^2`` with ``c`` = the running

@@ -60,6 +60,17 @@ _NEG = -1e30  # finite mask value; see module docstring
 # 5.12ms** — 1.25x; 2048-row tiles exceed VMEM.  Larger tiles win because
 # D=64 underfills the MXU contraction, so per-tile overheads (grid steps,
 # m/l bookkeeping) amortize over more rows.
+# Grid-dimension semantics for Mosaic (ADVICE r3 #1): the batch*heads and
+# row-block dims are embarrassingly parallel — marking them lets megacore
+# parts (v4/v5p: 2 TensorCores/chip) split the grid; only the dim a VMEM
+# scratch carry crosses must stay sequential ("arbitrary").  v5e has one
+# core, so this is measured-neutral here and a pod-scale enabler.
+def _sem(*dims):
+    from jax.experimental.pallas import tpu as _pltpu
+
+    return _pltpu.CompilerParams(dimension_semantics=dims)
+
+
 _BLOCK_Q = 1024
 _BLOCK_K = 1024
 # VMEM budget for the RESIDENT kernels' K/V rows (f32): each instance holds
@@ -444,6 +455,7 @@ def _make(
         return pl.pallas_call(
             kern,
             grid=(bh, s_len // bq, nk),
+            compiler_params=_sem("parallel", "parallel", "arbitrary"),
             in_specs=[
                 pl.BlockSpec((1, bq, d), qrow),
                 pl.BlockSpec((1, bk, d), krow),
@@ -478,6 +490,7 @@ def _make(
         return pl.pallas_call(
             kern,
             grid=(bh, s_len // bq),
+            compiler_params=_sem("parallel", "parallel"),
             in_specs=[
                 pl.BlockSpec((1, bq, d), row),
                 pl.BlockSpec((1, s_len, d), full),
@@ -525,6 +538,7 @@ def _make(
                 block_k=bk, nk=nk,
             ),
             grid=(bh, nq, nk),
+            compiler_params=_sem("parallel", "parallel", "arbitrary"),
             in_specs=[
                 pl.BlockSpec((1, bq, d), qrow),
                 pl.BlockSpec((1, bk, d), krow),
@@ -547,6 +561,7 @@ def _make(
                 block_k=bk, nq=nq,
             ),
             grid=(bh, nk, nq),
+            compiler_params=_sem("parallel", "parallel", "arbitrary"),
             in_specs=[
                 pl.BlockSpec((1, bq, d), qin),
                 pl.BlockSpec((1, bk, d), kout),
@@ -593,6 +608,7 @@ def _make(
                 _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
             ),
             grid=(bh, s_len // bq),
+            compiler_params=_sem("parallel", "parallel"),
             in_specs=[
                 pl.BlockSpec((1, bq, d), row),
                 pl.BlockSpec((1, s_len, d), full),
@@ -610,6 +626,7 @@ def _make(
                 _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
             ),
             grid=(bh, s_len // bk),
+            compiler_params=_sem("parallel", "parallel"),
             in_specs=[
                 pl.BlockSpec((1, s_len, d), full),
                 pl.BlockSpec((1, bk, d), row),
