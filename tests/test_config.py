@@ -23,6 +23,7 @@ GOOD = {
 }
 
 
+@pytest.mark.quick
 def test_roundtrip(tmp_path):
     p = tmp_path / "cfg.yml"
     p.write_text(yaml.safe_dump(GOOD))

@@ -13,6 +13,7 @@ from pytorch_distributed_training_tpu.data import (
 from pytorch_distributed_training_tpu.utils import make_iter_dataloader
 
 
+@pytest.mark.quick
 def test_shard_disjoint_cover_no_drop():
     n, world = 103, 4
     all_idx = []

@@ -81,6 +81,7 @@ def test_train_state_stays_replicated():
     assert bs_leaf.sharding.is_fully_replicated
 
 
+@pytest.mark.quick
 def test_sync_bn_stats_update_in_train_step():
     mesh, state, train_step, _ = _tiny_setup(sync_bn=True)
     before = jax.tree.map(np.asarray, state.batch_stats)
@@ -92,6 +93,7 @@ def test_sync_bn_stats_update_in_train_step():
     assert any(jax.tree.leaves(changed))
 
 
+@pytest.mark.quick
 def test_dp_step_matches_single_device():
     """8-device DP + SyncBN step == single-device full-batch step.
 

@@ -28,6 +28,7 @@ def _qkv(seed=0, s=S):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.quick
 def test_forward_matches_naive(causal):
     q, k, v = _qkv()
     ref = dot_product_attention(q, k, v, causal=causal)

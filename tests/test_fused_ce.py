@@ -14,6 +14,7 @@ from pytorch_distributed_training_tpu.ops.losses import cross_entropy_loss_xla
 
 
 @pytest.mark.parametrize("b,c", [(8, 10), (32, 1000), (40, 1000)])
+@pytest.mark.quick
 def test_forward_matches_reference(b, c):
     rng = np.random.default_rng(0)
     logits = jnp.asarray(rng.standard_normal((b, c)) * 3, jnp.float32)

@@ -1,10 +1,12 @@
 """accuracy + AverageMeter parity (reference: train_distributed.py:305-321)."""
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
 from pytorch_distributed_training_tpu.metrics import AverageMeter, accuracy
 
 
+@pytest.mark.quick
 def test_accuracy_topk():
     # 4 samples, 6 classes; construct known top-1/top-5 membership.
     logits = jnp.array(

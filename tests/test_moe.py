@@ -45,6 +45,7 @@ def _expert(params, e, xf):
     return h @ params["wo"][e] + params["bo"][e]
 
 
+@pytest.mark.quick
 def test_moe_dense_equivalence():
     """k=E with capacity for every token reduces the routed mixture to the
     dense convex combination sum_e p_e * expert_e(x) — the strongest whole-

@@ -53,6 +53,7 @@ def test_sgd_plain():
     _run_parity(momentum=0.0, weight_decay=0.0, nesterov=False)
 
 
+@pytest.mark.quick
 def test_sgd_momentum_wd():
     """The reference recipe: lr 0.1, momentum 0.9, wd 1e-4 (config/ResNet50.yml:7-11)."""
     _run_parity(momentum=0.9, weight_decay=1e-4, nesterov=False)
@@ -119,6 +120,7 @@ def _run_adamw_parity(weight_decay, betas=(0.9, 0.999), eps=1e-8, steps=6):
         )
 
 
+@pytest.mark.quick
 def test_adamw_parity_defaults():
     """torch.optim.AdamW defaults: decoupled decay applied BEFORE the Adam
     step, eps added to the bias-corrected denom OUTSIDE the sqrt."""

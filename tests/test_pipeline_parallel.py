@@ -74,6 +74,7 @@ def test_stack_unstack_roundtrip():
 
 
 @pytest.mark.parametrize("n_micro", [4, 8])
+@pytest.mark.quick
 def test_pp_step_matches_single_device(n_micro):
     """DP(2) x PP(4), M in {S, 2S}: loss and updated params must equal the
     single-device full-batch step.  SGD is the parity oracle because its
@@ -262,6 +263,7 @@ def test_1f1b_schedule_invariants():
 
 
 @pytest.mark.parametrize("n_micro", [4, 8])
+@pytest.mark.quick
 def test_1f1b_step_matches_single_device(n_micro):
     """DP(2) x PP(4) with the manual 1F1B backward (recompute-vjp per
     stage, cotangents riding the reverse ring, seed-masked grad

@@ -3,6 +3,7 @@
 The reference steps the scheduler every iteration (train_distributed.py:299),
 so milestones are iteration counts (SURVEY.md §7 hard part #1).
 """
+import pytest
 import numpy as np
 
 from pytorch_distributed_training_tpu.optimizers import SGD
@@ -14,6 +15,7 @@ from pytorch_distributed_training_tpu.schedulers import (
 )
 
 
+@pytest.mark.quick
 def test_multi_step_matches_torch():
     import torch
 

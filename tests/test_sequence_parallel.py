@@ -44,6 +44,7 @@ def _seq_mesh():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.quick
 def test_ring_attention_matches_full(causal):
     q, k, v = _make_qkv()
     mesh = _seq_mesh()

@@ -12,6 +12,7 @@ coverage.  Two properties pin it down:
      all-reduce after row-parallel matmuls, gradient all-reduce over data)
      are all inserted correctly.
 """
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -85,6 +86,7 @@ def test_tp_specs_cover_transformer_tree():
         assert flat[p] == P(), p
 
 
+@pytest.mark.quick
 def test_tp_step_matches_single_device():
     tokens, labels = _data(seed=1)
     opt = SGD(lr=0.05, momentum=0.9, weight_decay=1e-4)
@@ -221,6 +223,7 @@ def test_zero1_sharded_moments_match_plain():
     }, unsharded
 
 
+@pytest.mark.quick
 def test_zero2_sharded_grads_match_plain():
     """training.zero: 2 (ZeRO-2): gradient buffers constrained to the
     data-sharded layout must yield EXACTLY the plain-DP step — with and

@@ -136,6 +136,7 @@ def _jax_trajectory(imgs, labels, momentum=MOMENTUM, gamma=GAMMA):
     return losses, state
 
 
+@pytest.mark.quick
 def test_training_trajectory_matches_torch():
     imgs, labels = _batches()
     torch.manual_seed(0)
