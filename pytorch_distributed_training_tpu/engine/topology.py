@@ -149,19 +149,20 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
             f"training.microbatches ({r.microbatches}) must be >= "
             f"pipeline_parallelism ({r.pipe_par})"
         )
-    # Additive key ``training.zero``: ZeRO stage 0|1|2 (True = 1) —
-    # optimizer-state sharding over the data axis, stage 2 adds sharded
-    # gradient buffers (GSPMD LM path; parallel/tensor.py).  Parsed here
+    # Additive key ``training.zero``: ZeRO stage 0|1|2|3 (True = 1) —
+    # stage 1 shards optimizer moments over the data axis, stage 2 adds
+    # sharded gradient buffers, stage 3 shards the PARAMETERS themselves
+    # (FSDP semantics; GSPMD LM path, parallel/tensor.py).  Parsed here
     # because it changes BOTH the path selection and the model's
     # attention mode.
     zero_cfg = train_cfg.get("zero", False)
     if isinstance(zero_cfg, bool):
         r.zero = 1 if zero_cfg else 0  # True = ZeRO-1 (back-compat)
-    elif isinstance(zero_cfg, int) and zero_cfg in (0, 1, 2):
+    elif isinstance(zero_cfg, int) and zero_cfg in (0, 1, 2, 3):
         r.zero = zero_cfg
     else:
         raise ValueError(
-            f"training.zero must be a bool or a stage in (0, 1, 2), "
+            f"training.zero must be a bool or a stage in (0, 1, 2, 3), "
             f"got {zero_cfg!r}"
         )
     if r.zero and not r.is_lm:
@@ -173,8 +174,9 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
         # stage-sharded layouts — a different contract than ZeRO-2's
         # data-axis gradient scatter (ZeRO-1 moments do compose there)
         raise ValueError(
-            "training.zero: 2 does not compose with pipeline_parallelism "
-            "— use zero: 1 (sharded moments) under the pipeline"
+            f"training.zero: {r.zero} does not compose with "
+            "pipeline_parallelism — use zero: 1 (sharded moments) under "
+            "the pipeline"
         )
     if r.is_lm:
         for key, par in (

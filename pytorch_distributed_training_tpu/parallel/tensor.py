@@ -140,12 +140,18 @@ def tp_state_shardings(state, mesh: Mesh, zero: int = 0):
     structure matches ``params`` is treated as a parameter mirror; scalar
     fields (step counters) stay replicated.
 
-    ``zero``: ZeRO-1-style optimizer-state sharding — moment tensors are
-    ADDITIONALLY sharded over the ``data`` axis on their first dimension
-    (when divisible), cutting per-device optimizer memory by the data-axis
-    size.  The update math is unchanged: the GSPMD partitioner
-    reduce-scatters the gradients into the sharded moment update and
-    all-gathers the fresh params (config ``training.zero``).
+    ``zero`` (config ``training.zero``, stages cumulative):
+      1 — moment tensors ADDITIONALLY sharded over the ``data`` axis on
+          their first free dimension (when divisible): per-device optimizer
+          memory / data-axis size.  The partitioner reduce-scatters grads
+          into the sharded update and all-gathers fresh params.
+      2 — gradient buffers pinned to the same layout inside the step
+          (``zero_grad_shardings`` + ``with_sharding_constraint``).
+      3 — PARAMETERS live in the sharded layout too (FSDP semantics):
+          per-device parameter memory / data-axis size; the partitioner
+          all-gathers each weight at its use sites in forward/backward and
+          the whole update runs sharded with no gather at all.  The update
+          math is identical in every stage.
     """
     from ..engine.steps import TrainState  # avoid import cycle at module load
     from .mesh import DATA_AXIS
@@ -154,15 +160,16 @@ def tp_state_shardings(state, mesh: Mesh, zero: int = 0):
     param_sh = lm_tp_shardings(state.params, mesh)
     rep = NamedSharding(mesh, P())
     n_data = mesh.shape[DATA_AXIS]
-    moment_sh = (
-        jax.tree.map(
+    if int(zero) and n_data > 1:
+        moment_sh = jax.tree.map(
             lambda sh, leaf: zero_shard_moment(sh, leaf, mesh),
             param_sh,
             state.params,
         )
-        if zero and n_data > 1
-        else param_sh
-    )
+        if int(zero) >= 3:
+            param_sh = moment_sh  # params adopt the scattered layout (FSDP)
+    else:
+        moment_sh = param_sh
     opt_sh = mirror_opt_fields(state.opt_state, state.params, moment_sh, rep)
     bs_sh = jax.tree.map(lambda _: rep, state.batch_stats)
     return TrainState(params=param_sh, batch_stats=bs_sh, opt_state=opt_sh)

@@ -350,8 +350,9 @@ from tree_utils import flat_tree as _flat  # single source of the key format
 
 
 @pytest.mark.parametrize(
-    "target_extra", [{"tensor_parallelism": 2}, {"zero": 1}, {"zero": 2}],
-    ids=["tp2", "zero1", "zero2"],
+    "target_extra",
+    [{"tensor_parallelism": 2}, {"zero": 1}, {"zero": 2}, {"zero": 3}],
+    ids=["tp2", "zero1", "zero2", "zero3"],
 )
 def test_dp_checkpoint_restores_into_resharded_run(tmp_path, target_extra):
     """A plain-DP LM checkpoint restores into TP=2 / ZeRO-1 / ZeRO-2 runs:

@@ -107,6 +107,9 @@ SUPPORTED = [
     ("zero2xtp2", _cfg(zero=2, tensor_parallelism=2)),
     ("zero2xsp2", _cfg(zero=2, sequence_parallelism=2)),
     ("zero2-grad-accum", _cfg(zero=2, grad_accumulation=2)),
+    ("zero3", _cfg(zero=3)),
+    ("zero3xtp2", _cfg(zero=3, tensor_parallelism=2)),
+    ("zero3xsp2", _cfg(zero=3, sequence_parallelism=2)),
     ("moe-ep4", _cfg(model_extra={"moe_experts": 4}, tensor_parallelism=4)),
     ("lm-grad-accum", _cfg(grad_accumulation=2)),
     ("lm-smoothing", _cfg(label_smoothing=0.1)),
@@ -137,7 +140,9 @@ UNSUPPORTED = [
      "zero is only wired for the LM task"),
     ("zero2xpp2", _cfg(zero=2, pipeline_parallelism=2, microbatches=4),
      "zero: 2 does not compose with"),
-    ("zero3", _cfg(zero=3), "training.zero must be"),
+    ("zero3xpp2", _cfg(zero=3, pipeline_parallelism=2, microbatches=4),
+     "zero: 3 does not compose with"),
+    ("zero4", _cfg(zero=4), "training.zero must be"),
     ("spximg", _cfg(task="img", sequence_parallelism=2),
      "require model.name: TransformerLM"),
     ("moe-odd-ep", _cfg(model_extra={"moe_experts": 3}, tensor_parallelism=2),
