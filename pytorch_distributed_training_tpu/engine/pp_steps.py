@@ -214,7 +214,7 @@ def build_pp_lm_train_step(
     label_smoothing: float = 0.0,
     schedule: str = "gpipe",
     seq_axis=None,
-    zero: bool = False,
+    zero: int = 0,
 ):
     """Compile one DP x PP (optionally x TP) LM iteration.
 
@@ -457,7 +457,7 @@ def build_pp_lm_train_step(
         if MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1:
             manual = dict(axis_names=frozenset({DATA_AXIS, STAGE_AXIS}))
         if zero:
-            # ZeRO-1 x PP: only the GRADIENT computation runs in the
+            # ZeRO x PP: only the GRADIENT computation runs in the
             # manual shard_map (data-sharded moments must not enter it —
             # the manual in_specs would gather them, defeating the
             # sharding).  The elementwise update runs outside under GSPMD:
@@ -465,6 +465,10 @@ def build_pp_lm_train_step(
             # zero=True) make the partitioner reduce-scatter the grads
             # into the moment update and gather the fresh stage-sharded
             # params — the same construction as the GSPMD TP ZeRO path.
+            # Stage 2 additionally pins the grads themselves to the moment
+            # layout right at the shard_map boundary, so each device holds
+            # a 1/N_data gradient slice instead of the data-replicated
+            # stage-sharded tree (the PP analog of tp_steps' shard_grads).
             sharded_grads = jax.shard_map(
                 grads_fn,
                 mesh=mesh,
@@ -473,9 +477,21 @@ def build_pp_lm_train_step(
                 **manual,
             )
             param_sh = jax.tree.map(lambda x: x.sharding, state.params)
+            moment_sh = None
+            if int(zero) >= 2:
+                from ..parallel.tensor import param_mirror_fields
+
+                mirrors = param_mirror_fields(state.opt_state, state.params)
+                if mirrors:
+                    moment_sh = jax.tree.map(
+                        lambda x: x.sharding,
+                        getattr(state.opt_state, mirrors[0]),
+                    )
 
             def step(state: TrainState, tokens, labels):
                 grads, loss = sharded_grads(state.params, tokens, labels)
+                if moment_sh is not None:
+                    grads = jax.lax.with_sharding_constraint(grads, moment_sh)
                 lr = lr_fn(state.opt_state.step)
                 new_params, new_opt = optimizer.update(
                     grads, state.opt_state, state.params, lr

@@ -169,14 +169,13 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
         raise ValueError(
             "training.zero is only wired for the LM task (GSPMD path)"
         )
-    if r.zero >= 2 and r.pipe_par > 1:
-        # the pipeline step computes grads inside a manual shard_map with
-        # stage-sharded layouts — a different contract than ZeRO-2's
-        # data-axis gradient scatter (ZeRO-1 moments do compose there)
+    if r.zero >= 3 and r.pipe_par > 1:
+        # FSDP-scattered params would need a stage-stacked scattered
+        # layout inside the manual shard_map — not wired (ZeRO-1/2 do
+        # compose with the pipeline)
         raise ValueError(
             f"training.zero: {r.zero} does not compose with "
-            "pipeline_parallelism — use zero: 1 (sharded moments) under "
-            "the pipeline"
+            "pipeline_parallelism — use zero: 1 or 2 under the pipeline"
         )
     if r.is_lm:
         for key, par in (

@@ -32,12 +32,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import MODEL_AXIS
 
 __all__ = [
+    "param_mirror_fields",
     "lm_tp_param_specs",
     "lm_tp_shardings",
     "tp_state_shardings",
     "zero_grad_shardings",
     "mirror_opt_fields",
 ]
+
+
+def param_mirror_fields(opt_state, params):
+    """Names of opt-state fields whose pytree structure matches ``params``
+    (moment trees — SGD momentum, AdamW mu/nu).  THE single matching rule:
+    :func:`mirror_opt_fields` and every caller that needs "a
+    params-structured field" (e.g. engine/pp_steps' ZeRO-2 grad pinning)
+    share it so the rule cannot drift."""
+    params_struct = jax.tree.structure(params)
+    return [
+        name
+        for name in opt_state._fields
+        if jax.tree.structure(getattr(opt_state, name)) == params_struct
+    ]
 
 
 def mirror_opt_fields(opt_state, params, param_tree, rep):
@@ -49,14 +64,15 @@ def mirror_opt_fields(opt_state, params, param_tree, rep):
     (``parallel.pipeline.pp_state_shardings``), and pipeline-step
     (``engine.pp_steps``) sharding helpers so the structure-matching rule
     cannot drift between them."""
-    params_struct = jax.tree.structure(params)
+    mirrors = set(param_mirror_fields(opt_state, params))
     fields = {}
     for name in opt_state._fields:
-        field = getattr(opt_state, name)
-        if jax.tree.structure(field) == params_struct:
+        if name in mirrors:
             fields[name] = param_tree
         else:
-            fields[name] = jax.tree.map(lambda _: rep, field)
+            fields[name] = jax.tree.map(
+                lambda _: rep, getattr(opt_state, name)
+            )
     return type(opt_state)(**fields)
 
 

@@ -107,6 +107,9 @@ SUPPORTED = [
     ("zero2xtp2", _cfg(zero=2, tensor_parallelism=2)),
     ("zero2xsp2", _cfg(zero=2, sequence_parallelism=2)),
     ("zero2-grad-accum", _cfg(zero=2, grad_accumulation=2)),
+    ("zero2xpp2", _cfg(zero=2, pipeline_parallelism=2, microbatches=4)),
+    ("zero2xpp2xtp2", _cfg(zero=2, pipeline_parallelism=2,
+                           tensor_parallelism=2, microbatches=4)),
     ("zero3", _cfg(zero=3)),
     ("zero3xtp2", _cfg(zero=3, tensor_parallelism=2)),
     ("zero3xsp2", _cfg(zero=3, sequence_parallelism=2)),
@@ -138,8 +141,6 @@ UNSUPPORTED = [
      "ema is only wired for the image task"),
     ("zeroximg", _cfg(task="img", zero=True),
      "zero is only wired for the LM task"),
-    ("zero2xpp2", _cfg(zero=2, pipeline_parallelism=2, microbatches=4),
-     "zero: 2 does not compose with"),
     ("zero3xpp2", _cfg(zero=3, pipeline_parallelism=2, microbatches=4),
      "zero: 3 does not compose with"),
     ("zero4", _cfg(zero=4), "training.zero must be"),
