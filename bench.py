@@ -297,7 +297,9 @@ def bench_lm():
     remat = os.environ.get("BENCH_LM_REMAT", "0") == "1"
     lm = TransformerLM(
         vocab_size=vocab, max_len=seq, embed_dim=embed, depth=depth,
-        num_heads=heads, remat=remat, dtype=jnp.bfloat16,
+        num_heads=heads, remat=remat,
+        remat_policy=os.environ.get("BENCH_LM_REMAT_POLICY", "nothing"),
+        dtype=jnp.bfloat16,
     )
     opt = AdamW(lr=3e-4, weight_decay=0.1)
     rng = np.random.default_rng(0)

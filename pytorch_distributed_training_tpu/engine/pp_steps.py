@@ -85,7 +85,14 @@ def _stage_applies(model, seq_axis=None):
         def layer(x, p):
             return block.apply({"params": p}, x), None
 
-        f = jax.checkpoint(layer) if model.remat else layer
+        if model.remat:
+            from ..models.transformer_lm import resolve_remat_policy
+
+            f = jax.checkpoint(
+                layer, policy=resolve_remat_policy(model.remat_policy)
+            )
+        else:
+            f = layer
         x, _ = jax.lax.scan(f, x, blocks_local)
         return x
 

@@ -30,6 +30,26 @@ from .vit import MLP
 __all__ = ["TransformerLM"]
 
 
+def resolve_remat_policy(name: str):
+    """``model.remat_policy`` -> jax checkpoint policy (None = nothing
+    saveable, flax's nn.remat default).  Shared by the plain/GSPMD paths
+    (this module) and the pipeline step's own scan-level remat wrapper
+    (engine/pp_steps.py) so the mapping cannot drift.  Raises on unknown
+    names even when remat is off."""
+    policies = {
+        "nothing": None,
+        # matmul outputs saved, elementwise recomputed: +8.6% tokens/sec
+        # for remat runs on the bench chip (PERF.md round 4)
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"model.remat_policy must be one of {sorted(policies)}, "
+            f"got {name!r}"
+        )
+    return policies[name]
+
+
 class DecoderBlock(nn.Module):
     num_heads: int
     mlp_ratio: float
@@ -87,6 +107,12 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
     remat: bool = False
+    # Remat policy when ``remat`` is on (config ``model.remat_policy``):
+    # "nothing" (default: full recompute, minimal memory) or "dots"
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable: matmul
+    # outputs saved, elementwise recomputed — part of the memory saving at
+    # a fraction of the recompute; swept on the bench chip, PERF.md r4).
+    remat_policy: str = "nothing"
     dtype: Any = jnp.float32
     # MoE (beyond reference; ops/moe.py): every ``moe_every``-th block uses
     # a routed mixture of ``moe_experts`` expert MLPs (0 = dense everywhere).
@@ -136,7 +162,13 @@ class TransformerLM(nn.Module):
         # for O(depth) less activation HBM, the standard long-context lever
         # (config: model.remat: true).  Parameter shapes/values are
         # unchanged, so remat toggling is checkpoint-compatible.
-        block_cls = nn.remat(DecoderBlock) if self.remat else DecoderBlock
+        # validated regardless of ``remat`` so a typo'd policy on a
+        # remat-off config fails at init, not silently much later
+        policy = resolve_remat_policy(self.remat_policy)
+        block_cls = (
+            nn.remat(DecoderBlock, policy=policy) if self.remat
+            else DecoderBlock
+        )
         for i in range(self.depth):
             # GShard convention: MoE in every moe_every-th block (the
             # (moe_every-1) offset puts the first MoE at block 1 for the

@@ -566,3 +566,57 @@ def test_moe_validation():
     cfg["model"]["moe_every"] = 3
     with pytest.raises(ValueError, match="moe_every"):
         _run(cfg)
+
+
+def test_remat_policy_matches_nothing_policy():
+    """model.remat_policy: "dots" changes WHAT is saved, never the math —
+    losses equal the default policy (and bad values raise)."""
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import (
+        TrainState,
+        build_lm_train_step,
+    )
+    from pytorch_distributed_training_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from pytorch_distributed_training_tpu.optimizers import AdamW
+    from pytorch_distributed_training_tpu.parallel import (
+        make_sp_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import cosine_lr
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 64, (8, 33)).astype(np.int32)
+
+    def run(policy):
+        lm = TransformerLM(
+            vocab_size=64, max_len=32, embed_dim=32, depth=2, num_heads=4,
+            remat=True, remat_policy=policy,
+        )
+        mesh = make_sp_mesh(1)
+        params = lm.init(jax.random.PRNGKey(0), jnp.asarray(toks[:1, :32]))[
+            "params"
+        ]
+        opt = AdamW(lr=1e-3, weight_decay=0.01)
+        state = TrainState(
+            params=params, batch_stats={}, opt_state=opt.init(params)
+        )
+        state = jax.device_put(state, replicated_sharding(mesh))
+        step = build_lm_train_step(lm, opt, cosine_lr(1e-3, 100), mesh)
+        losses = []
+        for _ in range(2):
+            state, loss = step(
+                state, jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+            )
+            losses.append(float(loss))
+        return losses
+
+    np.testing.assert_allclose(run("nothing"), run("dots"), rtol=1e-6)
+
+    with pytest.raises(ValueError, match="remat_policy must be"):
+        run("everything")
