@@ -369,26 +369,46 @@ class Runner:
         return state_dict
 
     def _apply_pretrained_image(self, state: TrainState) -> TrainState:
-        """Replace params + BN stats with a ported torchvision checkpoint."""
-        from ..models.resnet import ResNet
-        from ..models.torch_port import import_torch_resnet_state_dict
+        """Replace params (+ BN stats) with a ported torchvision checkpoint.
 
-        if not isinstance(self.model, ResNet):
+        ResNets use the torchvision ResNet layout (params + running stats);
+        ViTs the torchvision ``VisionTransformer`` layout (params only — no
+        batch statistics).  Anything else is rejected with the family list.
+        """
+        from ..models.resnet import ResNet
+        from ..models.vit import ViT
+
+        if not isinstance(self.model, (ResNet, ViT)):
+            # family check BEFORE the (possibly multi-GB) torch.load
             raise ValueError(
-                f"model.pretrained: only the ResNet family has a torchvision "
-                f"state_dict layout (got model.name: {self.model_name})"
+                f"model.pretrained: only the ResNet and ViT families have a "
+                f"torchvision state_dict layout (got model.name: "
+                f"{self.model_name})"
             )
-        variables = {"params": state.params, "batch_stats": state.batch_stats}
-        loaded = import_torch_resnet_state_dict(
-            variables, self._load_torch_state_dict()
-        )
+        state_dict = self._load_torch_state_dict()
+        if isinstance(self.model, ResNet):
+            from ..models.torch_port import import_torch_resnet_state_dict
+
+            variables = {
+                "params": state.params, "batch_stats": state.batch_stats,
+            }
+            loaded = import_torch_resnet_state_dict(variables, state_dict)
+            new = state.replace(
+                params=loaded["params"], batch_stats=loaded["batch_stats"]
+            )
+        else:
+            from ..models.torch_port import import_torch_vit_state_dict
+
+            params = import_torch_vit_state_dict(
+                {"params": state.params}, state_dict,
+                num_heads=self.model.num_heads,
+            )
+            new = state.replace(params=params)
         self.logger.info(
             "Initialized %s from pretrained torch checkpoint %s",
             self.model_name, self.pretrained,
         )
-        return state.replace(
-            params=loaded["params"], batch_stats=loaded["batch_stats"]
-        )
+        return new
 
     def _apply_pretrained_lm(self, params):
         """Replace LM params with a ported torch decoder checkpoint."""

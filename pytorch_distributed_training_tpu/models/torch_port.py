@@ -33,6 +33,7 @@ import numpy as np
 __all__ = [
     "import_torch_resnet_state_dict",
     "import_torch_lm_state_dict",
+    "import_torch_vit_state_dict",
     "load_torchvision_checkpoint",
 ]
 
@@ -231,6 +232,119 @@ def import_torch_lm_state_dict(params: Mapping, state_dict: Mapping) -> Dict:
             )
         new_flat[path] = arr.astype(np.asarray(leaf).dtype)
         consumed.add(key)
+    extra = set(state_dict) - consumed
+    if extra:
+        raise KeyError(f"torch state_dict keys not consumed: {sorted(extra)}")
+    return _unflatten(new_flat)["params"]
+
+
+def _vit_qkv_perm(embed_dim: int, num_heads: int) -> np.ndarray:
+    """Column permutation torchvision-MHA -> heads-major qkv Dense.
+
+    torchvision ``in_proj_weight`` packs rows ``[q; k; v]`` (each [D, D],
+    heads contiguous within a block: torch index = which*D + h*hd + d);
+    our ``attn/qkv`` Dense lays its 3D output heads-major
+    (ops/attention.py: o = h*3*hd + which*hd + d) so a model mesh axis
+    splits on whole heads.  Returns ``perm`` with ``ours[:, o] =
+    torch_cols[:, perm[o]]``.
+    """
+    hd = embed_dim // num_heads
+    perm = np.empty(3 * embed_dim, dtype=np.int64)
+    for h in range(num_heads):
+        for which in range(3):
+            for d in range(hd):
+                perm[h * 3 * hd + which * hd + d] = which * embed_dim + h * hd + d
+    return perm
+
+
+def import_torch_vit_state_dict(
+    variables: Mapping, state_dict: Mapping[str, Any], num_heads: int
+) -> Dict:
+    """Convert a torchvision ``VisionTransformer`` ``state_dict`` (the
+    ``vit_b_16``-family layout: ``conv_proj``, ``class_token``,
+    ``encoder.pos_embedding``, ``encoder.layers.encoder_layer_{i}`` with
+    ``ln_1 / self_attention.{in_proj_*, out_proj} / ln_2 / mlp.{0,3}``,
+    ``encoder.ln``, ``heads.head``) into this framework's :class:`..models.vit.ViT`
+    variables.  Strict both ways, like the ResNet/LM ports."""
+    params = dict(variables["params"])
+    flat = _flatten({"params": params})
+    consumed: set = set()
+    new_flat: Dict[Tuple[str, ...], Any] = {}
+
+    current_path = [None]
+
+    def take(key: str) -> np.ndarray:
+        if key not in state_dict:
+            raise KeyError(
+                f"torch state_dict missing '{key}' "
+                f"(for Flax {current_path[0]})"
+            )
+        consumed.add(key)
+        return _to_numpy(state_dict[key])
+
+    perm_cache: Dict[int, np.ndarray] = {}
+    for path, leaf in flat.items():
+        current_path[0] = path
+        _, *mods, leaf_name = path
+        if mods == ["patch_embed"]:
+            arr = take(f"conv_proj.{'weight' if leaf_name == 'kernel' else 'bias'}")
+            if leaf_name == "kernel":
+                arr = np.transpose(arr, (2, 3, 1, 0))  # OIHW -> HWIO
+        elif not mods and leaf_name == "cls_token":
+            arr = take("class_token")
+        elif not mods and leaf_name == "pos_embedding":
+            arr = take("encoder.pos_embedding")
+        elif mods and mods[0].startswith("block"):
+            i = mods[0][len("block"):]
+            pre = f"encoder.layers.encoder_layer_{i}"
+            sub = mods[1]
+            if sub in ("ln1", "ln2"):
+                tname = "ln_1" if sub == "ln1" else "ln_2"
+                arr = take(
+                    f"{pre}.{tname}.{'weight' if leaf_name == 'scale' else 'bias'}"
+                )
+            elif sub == "attn" and mods[2] == "qkv":
+                embed = leaf.shape[0] if leaf_name == "kernel" else leaf.shape[0] // 3
+                perm = perm_cache.setdefault(
+                    int(embed), _vit_qkv_perm(int(embed), num_heads)
+                )
+                if leaf_name == "kernel":
+                    w = take(f"{pre}.self_attention.in_proj_weight")  # [3D, D]
+                    arr = w.T[:, perm]
+                else:
+                    arr = take(f"{pre}.self_attention.in_proj_bias")[perm]
+            elif sub == "attn" and mods[2] == "proj":
+                w = take(
+                    f"{pre}.self_attention.out_proj."
+                    f"{'weight' if leaf_name == 'kernel' else 'bias'}"
+                )
+                arr = w.T if leaf_name == "kernel" else w
+            elif sub == "mlp":
+                idx = {"fc1": 0, "fc2": 3}[mods[2]]  # torchvision Sequential
+                w = take(
+                    f"{pre}.mlp.{idx}."
+                    f"{'weight' if leaf_name == 'kernel' else 'bias'}"
+                )
+                arr = w.T if leaf_name == "kernel" else w
+            else:
+                raise KeyError(f"unmapped Flax leaf {path}")
+        elif mods == ["ln"]:
+            arr = take(
+                f"encoder.ln.{'weight' if leaf_name == 'scale' else 'bias'}"
+            )
+        elif mods == ["head"]:
+            w = take(
+                f"heads.head.{'weight' if leaf_name == 'kernel' else 'bias'}"
+            )
+            arr = w.T if leaf_name == "kernel" else w
+        else:
+            raise KeyError(f"unmapped Flax leaf {path}")
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"shape mismatch at {path}: torch {arr.shape} vs Flax "
+                f"{np.shape(leaf)}"
+            )
+        new_flat[path] = arr.astype(np.asarray(leaf).dtype)
     extra = set(state_dict) - consumed
     if extra:
         raise KeyError(f"torch state_dict keys not consumed: {sorted(extra)}")

@@ -36,7 +36,10 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
-        x = nn.gelu(x)
+        # exact (erf) GELU: torchvision's VisionTransformer convention —
+        # flax's tanh-approximate default costs ~2e-4 logit drift vs ported
+        # torchvision weights (tests/test_torch_port_vit.py)
+        x = nn.gelu(x, approximate=False)
         return nn.Dense(self.out, dtype=self.dtype, name="fc2")(x)
 
 

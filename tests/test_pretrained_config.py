@@ -175,10 +175,13 @@ def test_pretrained_wrong_classes_raises(tmp_path):
         _run_captured(_image_cfg(tmp_path, ckpt, n_classes=10))
 
 
-def test_pretrained_vit_unsupported(tmp_path):
+def test_pretrained_vit_wrong_dict_raises(tmp_path):
+    """ViT is a supported pretrained family (round 4,
+    tests/test_torch_port_vit.py pins the logit parity); a non-ViT dict
+    must still fail loudly with the missing torchvision key."""
     ckpt = tmp_path / "any.pt"
     torch.save({}, ckpt)
     cfg = _image_cfg(tmp_path, ckpt)
     cfg["model"]["name"] = "ViT-Ti16"
-    with pytest.raises(ValueError, match="ResNet family"):
+    with pytest.raises(KeyError, match="conv_proj"):
         _run_captured(cfg)

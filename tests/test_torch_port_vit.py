@@ -1,0 +1,200 @@
+"""torchvision ViT weight-port parity: torch eval logits == Flax eval logits.
+
+Extends the pretrained-ingestion surface to the ViT family.  torchvision
+itself isn't installed, so the torch side is a line-faithful twin of
+``torchvision.models.VisionTransformer`` — same module names
+(``conv_proj``, ``class_token``, ``encoder.pos_embedding``,
+``encoder.layers.encoder_layer_{i}`` with ``ln_1 / self_attention /
+ln_2 / mlp.{0,3}``, ``encoder.ln``, ``heads.head``) and the same packed
+``in_proj`` MHA layout, which is exactly the contract
+``import_torch_vit_state_dict`` targets.  Logit agreement with random
+weights pins the QKV head-permutation, pre-LN wiring, GELU MLP, class-token
+readout, and every transpose.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_training_tpu.models import get_model
+from pytorch_distributed_training_tpu.models.torch_port import (
+    import_torch_vit_state_dict,
+)
+
+DIM, HEADS, DEPTH, PATCH, IMG = 192, 3, 4, 16, 64
+
+
+class TorchEncoderLayer(tnn.Module):
+    """torchvision EncoderBlock: pre-LN MHA + pre-LN MLP, named like the
+    torchvision state_dict (ln_1 / self_attention / ln_2 / mlp.{0,3})."""
+
+    def __init__(self, dim, heads, mlp_ratio=4.0):
+        super().__init__()
+        self.ln_1 = tnn.LayerNorm(dim, eps=1e-6)
+        self.self_attention = tnn.MultiheadAttention(
+            dim, heads, batch_first=True
+        )
+        self.ln_2 = tnn.LayerNorm(dim, eps=1e-6)
+        hidden = int(dim * mlp_ratio)
+        self.mlp = tnn.Sequential(
+            tnn.Linear(dim, hidden),
+            tnn.GELU(),
+            tnn.Dropout(0.0),
+            tnn.Linear(hidden, dim),
+            tnn.Dropout(0.0),
+        )
+
+    def forward(self, x):
+        y = self.ln_1(x)
+        a, _ = self.self_attention(y, y, y, need_weights=False)
+        x = x + a
+        return x + self.mlp(self.ln_2(x))
+
+
+class TorchViT(tnn.Module):
+    def __init__(self, num_classes, dim=DIM, heads=HEADS, depth=DEPTH,
+                 patch=PATCH):
+        super().__init__()
+        self.conv_proj = tnn.Conv2d(3, dim, patch, patch)
+        self.class_token = tnn.Parameter(torch.zeros(1, 1, dim))
+        n_tokens = (IMG // patch) ** 2 + 1
+
+        class Encoder(tnn.Module):
+            def __init__(self):
+                super().__init__()
+                self.pos_embedding = tnn.Parameter(
+                    torch.empty(1, n_tokens, dim).normal_(std=0.02)
+                )
+                self.layers = tnn.ModuleDict(
+                    {
+                        f"encoder_layer_{i}": TorchEncoderLayer(dim, heads)
+                        for i in range(depth)
+                    }
+                )
+                self.ln = tnn.LayerNorm(dim, eps=1e-6)
+
+        self.encoder = Encoder()
+        self.heads = tnn.ModuleDict({"head": tnn.Linear(dim, num_classes)})
+
+    def forward(self, x):
+        p = self.conv_proj(x)  # [B, D, H/ps, W/ps]
+        b, d, gh, gw = p.shape
+        tokens = p.reshape(b, d, gh * gw).permute(0, 2, 1)
+        cls = self.class_token.expand(b, -1, -1)
+        x = torch.cat([cls, tokens], dim=1) + self.encoder.pos_embedding
+        for i in range(len(self.encoder.layers)):
+            x = self.encoder.layers[f"encoder_layer_{i}"](x)
+        x = self.encoder.ln(x)
+        return self.heads["head"](x[:, 0])
+
+
+def _randomized_twin(num_classes=10, seed=0):
+    torch.manual_seed(seed)
+    tm = TorchViT(num_classes)
+    with torch.no_grad():
+        tm.class_token.normal_(0, 0.02)
+    return tm
+
+
+def test_vit_eval_logits_match_torch():
+    tm = _randomized_twin()
+    tm.eval()
+    from pytorch_distributed_training_tpu.models.vit import ViT
+
+    model = ViT(num_classes=10, patch_size=PATCH, embed_dim=DIM,
+                depth=DEPTH, num_heads=HEADS)
+    rng = np.random.default_rng(2)
+    img = rng.standard_normal((4, IMG, IMG, 3)).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))
+    params = import_torch_vit_state_dict(
+        variables, tm.state_dict(), num_heads=HEADS
+    )
+    out = np.asarray(
+        model.apply({"params": params}, jnp.asarray(img), train=False)
+    )
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_vit_port_strictness():
+    tm = _randomized_twin()
+    from pytorch_distributed_training_tpu.models.vit import ViT
+
+    model = ViT(num_classes=10, patch_size=PATCH, embed_dim=DIM,
+                depth=DEPTH, num_heads=HEADS)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, IMG, IMG, 3)))
+
+    missing = dict(tm.state_dict())
+    missing.pop("encoder.layers.encoder_layer_0.ln_1.weight")
+    with pytest.raises(KeyError, match="missing"):
+        import_torch_vit_state_dict(variables, missing, num_heads=HEADS)
+
+    extra = dict(tm.state_dict())
+    extra["stray.weight"] = torch.zeros(3)
+    with pytest.raises(KeyError, match="not consumed"):
+        import_torch_vit_state_dict(variables, extra, num_heads=HEADS)
+
+    wrong = {
+        k: (torch.zeros(7, 7) if k.endswith("in_proj_weight") else v)
+        for k, v in tm.state_dict().items()
+    }
+    with pytest.raises((ValueError, IndexError)):
+        import_torch_vit_state_dict(variables, wrong, num_heads=HEADS)
+
+
+def test_vit_pretrained_config(tmp_path):
+    """model.pretrained covers the ViT family through the Runner: the
+    config-initialized state reproduces the twin's eval logits."""
+    from pytorch_distributed_training_tpu.engine import Runner
+
+    torch.manual_seed(1)
+    tm = TorchViT(4, dim=192, heads=3, depth=12, patch=16)  # ViT-Ti16 dims
+    with torch.no_grad():
+        tm.class_token.normal_(0, 0.02)
+    tm.eval()
+    ckpt = tmp_path / "vit_ti16.pt"
+    torch.save(tm.state_dict(), ckpt)
+
+    class _SetupOnly(Runner):
+        def _train_loop(self, iter_generator, train_cfg):
+            self.captured = self.state
+
+    cfg = {
+        "dataset": {
+            "name": "synthetic", "root": str(tmp_path), "n_classes": 4,
+            "image_size": IMG, "n_samples": 64,
+        },
+        "training": {
+            "optimizer": {"name": "AdamW", "lr": 3.0e-4, "weight_decay": 0.1},
+            "lr_schedule": {"name": "cosine", "total_iters": 100},
+            "train_iters": 2,
+            "print_interval": 1,
+            "val_interval": 2,
+            "batch_size": 16,
+            "num_workers": 2,
+            "sync_bn": False,
+        },
+        "validation": {"batch_size": 16, "num_workers": 2},
+        "model": {"name": "ViT-Ti16", "pretrained": str(ckpt)},
+    }
+    runner = _SetupOnly(
+        num_nodes=1, rank=0, seed=3, dist_url="tcp://127.0.0.1:9927",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: None,
+    )
+    runner()
+    rng = np.random.default_rng(5)
+    img = rng.standard_normal((4, IMG, IMG, 3)).astype(np.float32)
+    out = np.asarray(
+        runner.model.apply(
+            {"params": runner.captured.params}, jnp.asarray(img), train=False
+        )
+    )
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(img).permute(0, 3, 1, 2)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
