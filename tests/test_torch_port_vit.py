@@ -15,12 +15,10 @@ import numpy as np
 import pytest
 import torch
 import torch.nn as tnn
-import torch.nn.functional as F
 
 import jax
 import jax.numpy as jnp
 
-from pytorch_distributed_training_tpu.models import get_model
 from pytorch_distributed_training_tpu.models.torch_port import (
     import_torch_vit_state_dict,
 )
