@@ -1,0 +1,90 @@
+"""Within-session A/B of the flash backward variants at the LM bench
+attention shape (B4 H16 S2048 D64, bf16, causal, fwd+bwd).  Throwaway
+round-5 measurement helper; not part of the package."""
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(iters=40, windows=3):
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+
+    fa._make.cache_clear()
+    rng = np.random.default_rng(0)
+    shape = (4, 2048, 16, 64)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(shape, np.float32), jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    def f(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    grad_fn = jax.value_and_grad(f, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v):
+        def body(_, q_c):
+            _, (dq, dk, dv) = grad_fn(q_c, k, v)
+            return q_c + jnp.bfloat16(1e-3) * dq + jnp.bfloat16(1e-6) * (dk + dv)
+
+        return jnp.float32(jax.lax.fori_loop(0, iters, body, q)).sum()
+
+    float(many(q, k, v))
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(many(q, k, v))
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best, grad_fn(q, k, v)
+
+
+variants = [
+    ("r4-split-f32dots", {"PDT_FLASH_NO_FUSED_BWD": "1", "PDT_FLASH_F32_DOTS": "1"}, None),
+    ("split-bf16dots", {"PDT_FLASH_NO_FUSED_BWD": "1"}, None),
+    ("fused-bf16 512/1024", {}, (512, 1024)),
+    ("fused-bf16 1024/512", {}, (1024, 512)),
+    ("fused-bf16 512/512", {}, (512, 512)),
+    ("fused-bf16 256/1024", {}, (256, 1024)),
+]
+results = {}
+grads = {}
+for name, env, tiles in variants:
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    for k2 in ("PDT_FLASH_NO_FUSED_BWD", "PDT_FLASH_F32_DOTS"):
+        os.environ.pop(k2, None)
+    os.environ.update(env)
+    if tiles:
+        fa._BLOCK_Q_FUSED, fa._BLOCK_K_FUSED = tiles
+    try:
+        dt, (loss, g) = timed()
+    except Exception as e:  # noqa: BLE001 - sweep must survive a VMEM OOM
+        print(json.dumps({"variant": name, "error": str(e)[:160]}), flush=True)
+        continue
+    results[name] = round(dt * 1e3, 3)
+    grads[name] = (float(loss), g)
+    print(json.dumps({"variant": name, "ms_per_op": results[name]}), flush=True)
+
+ref_l, ref_g = grads["r4-split-f32dots"]
+for name, (l, g) in grads.items():
+    err = max(
+        float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        for a, b in zip(g, ref_g)
+    )
+    print(
+        json.dumps(
+            {"variant": name, "loss_abs_err_vs_r4": abs(l - ref_l),
+             "grad_max_abs_err_vs_r4": err}
+        ),
+        flush=True,
+    )

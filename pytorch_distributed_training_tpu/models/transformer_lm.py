@@ -56,6 +56,9 @@ class DecoderBlock(nn.Module):
     seq_axis: Optional[str]
     seq_impl: str
     dtype: Any = jnp.float32
+    # mesh hint for the GSPMD flash island (ops/attention.py); set by the
+    # GSPMD step builders via TransformerLM.flash_mesh
+    flash_mesh: Optional[Any] = None
     # MoE (ops/moe.py): experts > 0 swaps the dense MLP for a top-k routed
     # mixture; the residual around it means capacity-dropped tokens pass
     # through unchanged
@@ -74,6 +77,7 @@ class DecoderBlock(nn.Module):
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
             dtype=self.dtype,
+            flash_mesh=self.flash_mesh,
             name="attn",
         )(y)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -123,6 +127,12 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
     moe_every: int = 2
+    # Mesh hint for the GSPMD flash island: the GSPMD step builders
+    # (engine/tp_steps) clone the model with the step's mesh so attention
+    # runs the Pallas flash kernel inside a shard_map island instead of
+    # the O(S^2) einsum the partitioner would otherwise get.  Static
+    # config only — parameter shapes/values are unchanged.
+    flash_mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -186,6 +196,9 @@ class TransformerLM(nn.Module):
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_aux_weight=self.moe_aux_weight,
+                flash_mesh=(
+                    self.flash_mesh if not self.is_initializing() else None
+                ),
                 name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)

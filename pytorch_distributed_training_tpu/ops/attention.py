@@ -19,11 +19,12 @@ equivalent in tests/test_sequence_parallel.py.
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.sharding import PartitionSpec as P
 
 from ..parallel.sequence import ring_attention, ulysses_attention
 from ..utils.vma import varying_axes_of
@@ -35,9 +36,11 @@ def _use_flash(q) -> bool:
 
     The Pallas path runs when (a) on real TPU, (b) INSIDE shard_map
     (varying mesh axes present) — under plain GSPMD jit a pallas_call has
-    no SPMD partitioning rule, so the sharded TP/ZeRO/MoE paths keep the
-    einsum attention XLA can partition, while the shard_map LM paths
-    (engine/sp_steps — also the plain-DP default) get the kernel —
+    no SPMD partitioning rule, so without a mesh hint the sharded
+    TP/ZeRO/MoE paths keep the einsum attention XLA can partition (the
+    ``mesh`` argument to :func:`dot_product_attention` lifts this via a
+    shard_map island; see :func:`_gspmd_island_spec`), while the shard_map
+    LM paths (engine/sp_steps — also the plain-DP default) get the kernel —
     (c) the sequence divides the 128 blocks, and (d) the kernel's resident
     K/V rows fit the VMEM budget.  ``PDT_DISABLE_PALLAS=1`` forces XLA
     (same escape hatch as ops/losses.py).
@@ -52,6 +55,76 @@ def _use_flash(q) -> bool:
     return flash_shapes_ok(s_len, d)
 
 
+def _gspmd_island_spec(q_shape, mesh):
+    """Partitioning plan for the flash island inside a GSPMD program, or
+    ``None`` to stay on the XLA einsum path.
+
+    Returns ``(spec, interpret)``: ``spec`` is the q/k/v/out
+    ``PartitionSpec`` — batch over ``data``, heads over every present
+    model-ish axis (``model`` and, on 3-D meshes, ``sequence``: resharding
+    sequence-sharded activations to head-sharded full-sequence blocks is
+    exactly the DeepSpeed-Ulysses all-to-all, and GSPMD inserts it from
+    the spec change).  Attention is independent per (batch, head), so the
+    island body needs no collectives and shard_map AD stays collective-free
+    too.  ``None`` when shapes don't divide the mesh, flash is ineligible,
+    or ``PDT_FLASH_GSPMD=0``.  ``interpret`` (``PDT_FLASH_GSPMD_INTERPRET=1``,
+    CPU test meshes) runs the island kernels in Pallas interpreter mode.
+    """
+    import os
+
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+    from ..parallel.sequence import SEQUENCE_AXIS
+    from .flash_attention import flash_enabled, flash_shapes_ok
+
+    if os.environ.get("PDT_FLASH_GSPMD", "1") == "0":
+        return None
+    interpret = os.environ.get("PDT_FLASH_GSPMD_INTERPRET", "0") != "0"
+    if not (flash_enabled() or interpret):
+        return None
+    b, s_len, h, d = q_shape
+    if not flash_shapes_ok(s_len, d):
+        return None
+    head_axes = tuple(
+        ax for ax in (MODEL_AXIS, SEQUENCE_AXIS) if ax in mesh.axis_names
+    )
+    n_head = 1
+    for ax in head_axes:
+        n_head *= mesh.shape[ax]
+    dp = mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
+    if b % dp or h % n_head:
+        return None
+    spec = P(
+        DATA_AXIS if DATA_AXIS in mesh.axis_names else None,
+        None,
+        head_axes if head_axes else None,
+        None,
+    )
+    return spec, interpret
+
+
+def _gspmd_flash(q, k, v, causal, sm_scale, mesh, spec, interpret):
+    """shard_map island: per-device [B/dp, S, H/n, D] blocks run the local
+    Pallas flash kernel; the GSPMD partitioner reshards operands to the
+    island's layout (and back) around it.  check_vma=False only under the
+    interpreter (its state discharge does not propagate varying-axes
+    through in-kernel pl.ds reads — same caveat as
+    tests/test_flash_attention.py; Mosaic lowering never discharges)."""
+    from .flash_attention import flash_attention
+
+    def local(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=not interpret,
+    )(q, k, v)
+
+
 def dot_product_attention(
     q,
     k,
@@ -60,6 +133,7 @@ def dot_product_attention(
     sm_scale: Optional[float] = None,
     impl: Optional[str] = None,
     interpret: bool = False,
+    mesh=None,
 ):
     """Full attention on the local shard: ``[B, S, H, D] -> [B, S, H, D]``.
 
@@ -67,6 +141,13 @@ def dot_product_attention(
     (:mod:`.flash_attention`) when eligible (see :func:`_use_flash`),
     ``"flash"``/``"xla"`` force a path.  ``interpret`` runs a forced
     flash path in Pallas interpreter mode (CPU test meshes).
+
+    ``mesh``: set by the GSPMD step builders (engine/tp_steps via
+    ``TransformerLM.flash_mesh``) — under plain jit a ``pallas_call`` has
+    no SPMD partitioning rule, so the kernel runs inside a shard_map
+    island partitioned per :func:`_gspmd_island_spec` (TP/ZeRO/FSDP/MoE
+    paths stop paying the O(S^2) einsum).  Ignored inside shard_map or
+    when the island is ineligible.
     """
     if impl not in (None, "flash", "xla"):
         raise ValueError(f"unknown attention impl {impl!r}")
@@ -76,6 +157,10 @@ def dot_product_attention(
         return flash_attention(
             q, k, v, causal=causal, sm_scale=sm_scale, interpret=interpret
         )
+    if impl is None and mesh is not None and not varying_axes_of(q):
+        plan = _gspmd_island_spec(q.shape, mesh)
+        if plan is not None:
+            return _gspmd_flash(q, k, v, causal, sm_scale, mesh, *plan)
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     s = jnp.einsum(
@@ -108,6 +193,9 @@ class MultiHeadAttention(nn.Module):
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
     dtype: jnp.dtype = jnp.float32
+    # mesh hint for the GSPMD flash island (engine/tp_steps sets it via
+    # TransformerLM.flash_mesh); None = einsum under plain jit
+    flash_mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
@@ -124,7 +212,9 @@ class MultiHeadAttention(nn.Module):
         qkv = qkv.reshape(b, s, self.num_heads, 3, head_dim)
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
         if self.seq_axis is None:
-            out = dot_product_attention(q, k, v, causal=self.causal)
+            out = dot_product_attention(
+                q, k, v, causal=self.causal, mesh=self.flash_mesh
+            )
         elif self.seq_impl == "ring":
             out = ring_attention(q, k, v, axis_name=self.seq_axis, causal=self.causal)
         elif self.seq_impl == "ulysses":

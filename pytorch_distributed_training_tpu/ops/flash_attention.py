@@ -21,12 +21,29 @@ blocks carrying ``(m, l, acc)`` in registers.  Causal masking also BOUNDS
 the loop — K blocks entirely above the diagonal are never visited, so the
 causal forward does ~half the FLOPs, not masked-full work.
 
-Backward is the standard flash recomputation split into two kernels wired
-through ``jax.custom_vjp``: a dQ kernel (grid over Q tiles, loop over K)
-and a dK/dV kernel (grid over K tiles, loop over Q, starting at the
-diagonal when causal), both recomputing ``p = exp(s - lse)`` from the
-forward's saved per-row logsumexp; ``delta = rowsum(dO * O)`` is one cheap
-XLA elementwise pass outside the kernels.
+Backward is the standard flash recomputation wired through
+``jax.custom_vjp``.  For resident shapes it is ONE fused kernel
+(``_dqkv_kernel``, round 5): grid over Q tiles with dK/dV accumulated
+in-place in revisited f32 output blocks that stay VMEM-resident across the
+whole (batch, head) — ``s``/``p``/``dp``/``ds`` are computed once per tile
+pair instead of twice, cutting the backward from 7 to 5 matmuls per tile
+and halving its HBM reads (the round-4 quantified D=64 backward MFU gap,
+PERF.md).  Shapes whose fused VMEM footprint exceeds the budget fall back
+to the original two-pass split: a dQ kernel (grid over Q tiles, loop over
+K) and a dK/dV kernel (grid over K tiles, loop over Q, starting at the
+diagonal when causal).  All variants recompute ``p = exp(s - lse)`` from
+the forward's saved per-row logsumexp; ``delta = rowsum(dO * O)`` is one
+cheap XLA elementwise pass outside the kernels.
+
+MXU rate (round 5): for bf16 inputs the kernels feed the dots bf16
+operands with f32 accumulation (``preferred_element_type``) instead of
+upcasting to f32 first — f32 matmuls run at a fraction of the MXU's bf16
+rate (multi-pass decomposition), so the upcast was throttling every score/
+output contraction.  bf16xbf16 products are exact in f32 (8-bit
+mantissas), so the forward's ``s`` is unchanged up to summation order; the
+``p``/``ds`` operands are rounded to bf16 before their dots (the standard
+flash-attention convention).  f32 inputs keep full-f32 dots, and
+``PDT_FLASH_F32_DOTS=1`` forces them for bf16 too.
 
 Masked scores use a large-negative finite constant (not ``-inf``): every
 causal row has at least one valid column, so ``exp(-1e30 - m)`` underflows
@@ -73,6 +90,13 @@ def _sem(*dims):
 
 _BLOCK_Q = 1024
 _BLOCK_K = 1024
+# The FUSED backward keeps s/p/dp/ds (plus their bf16 dot copies) live in
+# one kernel body — at 1024x1024 those f32 tiles alone are ~16MB and Mosaic
+# OOMs the 16MB scoped-VMEM stack (measured: 16.74M at S=2048 D=64 BH=64).
+# Halving the Q tile halves every [bq, bk] intermediate; swept on the bench
+# chip (see PERF.md round 5).
+_BLOCK_Q_FUSED = 512
+_BLOCK_K_FUSED = 1024
 # VMEM budget for the RESIDENT kernels' K/V rows (f32): each instance holds
 # 2 full [S, D] f32 operands plus tiles/accumulators; stay well under the
 # ~16MB scoped VMEM.  Sequences past this budget no longer fall back to the
@@ -94,6 +118,28 @@ def _resident_ok(s_len: int, d: int) -> bool:
     if os.environ.get("PDT_FLASH_FORCE_STREAM", "0") != "0":
         return False
     return 2 * s_len * d * 4 <= _VMEM_BYTES
+
+
+def _fused_bwd_ok(
+    s_len: int, d: int, itemsize: int, bf16_dots: bool, interpret: bool
+) -> bool:
+    """True when the fused dQ/dK/dV backward fits scoped VMEM: full K/V in
+    the input dtype plus full dK/dV f32 accumulator blocks must all stay
+    resident.  Shapes at the resident gate's edge (S*D near 1M) exceed this
+    and fall back to the split two-pass backward.  On real TPU the fused
+    path additionally requires bf16 dots: with f32 operand casts Mosaic's
+    live [block_q, block_k] f32 intermediates (s/p/dp/ds at once, ~4MB each
+    at the 1024 tiles) overflow the 16MB scoped-VMEM stack — measured OOM
+    at S=2048 D=64; bf16-dot tiles fit.  f32 inputs keep the split kernels.
+    ``PDT_FLASH_NO_FUSED_BWD=1`` forces the split path (A/B benching and
+    the fused-vs-split bitwise oracle)."""
+    import os
+
+    if os.environ.get("PDT_FLASH_NO_FUSED_BWD", "0") != "0":
+        return False
+    if not (bf16_dots or interpret):
+        return False
+    return 2 * s_len * d * (itemsize + 4) <= _VMEM_BYTES
 
 
 def flash_shapes_ok(s_len: int, d: int) -> bool:
@@ -129,11 +175,17 @@ def _out_struct(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref,
+    *, scale, causal, block_q, block_k, bf16_dots,
+):
     i = pl.program_id(1)
     s_len = k_ref.shape[1]
     nk = s_len // block_k
-    q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+    if bf16_dots:
+        q = q_ref[0]  # bf16 into the MXU; scale folds into s below
+    else:
+        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
 
     if causal:
         # K blocks strictly above this Q tile's last row never contribute
@@ -143,11 +195,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        if not bf16_dots:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
+        if bf16_dots:
+            s = s * scale
         if causal:
             qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -156,8 +213,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
         l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+        pv = p.astype(jnp.bfloat16) if bf16_dots else p
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pv, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return m_new, l_new, acc
 
@@ -174,13 +232,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, scale, causal, block_q, block_k,
+    *, scale, causal, block_q, block_k, bf16_dots,
 ):
     i = pl.program_id(1)
     s_len = k_ref.shape[1]
     nk = s_len // block_k
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0] if bf16_dots else q_ref[0].astype(jnp.float32)
+    do = do_ref[0] if bf16_dots else do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     nj = (
@@ -190,8 +248,11 @@ def _dq_kernel(
     )
 
     def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        if not bf16_dots:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -204,8 +265,79 @@ def _dq_kernel(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
+        dsc = ds.astype(jnp.bfloat16) if bf16_dots else ds
         return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            dsc, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, nj, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dqkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+    *, scale, causal, block_q, block_k, bf16_dots,
+):
+    """Fused backward: one pass over the (Q tile, K tile) pairs produces dQ,
+    dK AND dV.  Grid is (BH, S/block_q) with the Q-tile dim sequential
+    ("arbitrary"): dK/dV ride in f32 output blocks whose index map ignores
+    the Q-tile index, so Pallas keeps them VMEM-resident across the whole
+    (batch, head) and the kernel accumulates into them in place (zeroed at
+    the first Q tile).  ``s``/``p``/``dp``/``ds`` are computed once per
+    visited tile pair — the split path computes them twice (once in each
+    pass).  Accumulation order over tiles is identical to the split
+    kernels' (ascending i for dK/dV, ascending j for dQ, f32 adds), so the
+    results are bitwise-equal to the split path (pinned in
+    tests/test_flash_attention.py)."""
+    i = pl.program_id(1)
+    s_len = k_ref.shape[1]
+    nk = s_len // block_k
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[...] = jnp.zeros(dk_ref.shape, dk_ref.dtype)
+        dv_ref[...] = jnp.zeros(dv_ref.shape, dv_ref.dtype)
+
+    q = q_ref[0] if bf16_dots else q_ref[0].astype(jnp.float32)
+    do = do_ref[0] if bf16_dots else do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    nj = (
+        jnp.minimum(nk, ((i + 1) * block_q + block_k - 1) // block_k)
+        if causal
+        else nk
+    )
+
+    def body(j, dq):
+        ks = pl.ds(j * block_k, block_k)
+        kb = k_ref[0, ks, :]
+        vb = v_ref[0, ks, :]
+        if not bf16_dots:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qg >= kg, s, _NEG)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        pc = p.astype(jnp.bfloat16) if bf16_dots else p
+        dv_ref[0, ks, :] = dv_ref[0, ks, :] + jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dsc = ds.astype(jnp.bfloat16) if bf16_dots else ds
+        dk_ref[0, ks, :] = dk_ref[0, ks, :] + jax.lax.dot_general(
+            dsc, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dq + jax.lax.dot_general(
+            dsc, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     d = q_ref.shape[-1]
@@ -215,20 +347,23 @@ def _dq_kernel(
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, scale, causal, block_q, block_k,
+    *, scale, causal, block_q, block_k, bf16_dots,
 ):
     j = pl.program_id(1)
     s_len = q_ref.shape[1]
     nq = s_len // block_q
-    kb = k_ref[0].astype(jnp.float32)  # [bk, d]
-    vb = v_ref[0].astype(jnp.float32)
+    kb = k_ref[0] if bf16_dots else k_ref[0].astype(jnp.float32)  # [bk, d]
+    vb = v_ref[0] if bf16_dots else v_ref[0].astype(jnp.float32)
     # Q tiles strictly before this K tile's first row never attend to it
     i0 = (j * block_k) // block_q if causal else 0
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        if not bf16_dots:
+            q = q.astype(jnp.float32)
+            do = do.astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * block_q, block_q), 0]
         delta = delta_ref[0, pl.ds(i * block_q, block_q), 0]
         s = scale * jax.lax.dot_general(
@@ -239,15 +374,17 @@ def _dkv_kernel(
             kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qg >= kg, s, _NEG)
         p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        pc = p.astype(jnp.bfloat16) if bf16_dots else p
         dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
+        dsc = ds.astype(jnp.bfloat16) if bf16_dots else ds
         dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            dsc, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return dk, dv
 
@@ -269,7 +406,7 @@ def _dkv_kernel(
 # ----------------------------------------------------------------------
 def _fwd_stream_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, scale, causal, block_q, block_k, nk,
+    *, scale, causal, block_q, block_k, nk, bf16_dots,
 ):
     i = pl.program_id(1)  # Q tile (outer)
     j = pl.program_id(2)  # K tile (inner, sequential)
@@ -284,12 +421,19 @@ def _fwd_stream_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
-        kb = k_ref[0].astype(jnp.float32)  # [bk, d]
-        vb = v_ref[0].astype(jnp.float32)
+        if bf16_dots:
+            q = q_ref[0]  # [bq, d] bf16; scale folds into s below
+            kb = k_ref[0]
+            vb = v_ref[0]
+        else:
+            q = q_ref[0].astype(jnp.float32) * scale  # [bq, d]
+            kb = k_ref[0].astype(jnp.float32)  # [bk, d]
+            vb = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [bq, bk]
+        if bf16_dots:
+            s = s * scale
         if causal:
             qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -301,8 +445,9 @@ def _fwd_stream_kernel(
         p = jnp.exp(s - m_new[:, :1])
         m_scr[...] = m_new
         l_scr[...] = alpha * l_prev + jnp.sum(p, axis=-1)[:, None]
+        pv = p.astype(jnp.bfloat16) if bf16_dots else p
         acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pv, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     @pl.when(j == nk - 1)
@@ -314,7 +459,7 @@ def _fwd_stream_kernel(
 
 def _dq_stream_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, scale, causal, block_q, block_k, nk,
+    *, scale, causal, block_q, block_k, nk, bf16_dots,
 ):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -327,12 +472,15 @@ def _dq_stream_kernel(
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        if bf16_dots:
+            q, do, kb, vb = q_ref[0], do_ref[0], k_ref[0], v_ref[0]
+        else:
+            q = q_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -345,8 +493,9 @@ def _dq_stream_kernel(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
+        dsc = ds.astype(jnp.bfloat16) if bf16_dots else ds
         dq_scr[...] += jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            dsc, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     @pl.when(j == nk - 1)
@@ -356,7 +505,7 @@ def _dq_stream_kernel(
 
 def _dkv_stream_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, scale, causal, block_q, block_k, nq,
+    dk_scr, dv_scr, *, scale, causal, block_q, block_k, nq, bf16_dots,
 ):
     j = pl.program_id(1)  # K tile (outer)
     i = pl.program_id(2)  # Q tile (inner, sequential)
@@ -370,10 +519,13 @@ def _dkv_stream_kernel(
 
     @pl.when(run)
     def _compute():
-        kb = k_ref[0].astype(jnp.float32)
-        vb = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        if bf16_dots:
+            kb, vb, q, do = k_ref[0], v_ref[0], q_ref[0], do_ref[0]
+        else:
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            q = q_ref[0].astype(jnp.float32)
+            do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, :, 0]
         delta = delta_ref[0, :, 0]
         s = scale * jax.lax.dot_general(
@@ -384,15 +536,17 @@ def _dkv_stream_kernel(
             kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qg >= kg, s, _NEG)
         p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        pc = p.astype(jnp.bfloat16) if bf16_dots else p
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * scale
+        dsc = ds.astype(jnp.bfloat16) if bf16_dots else ds
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            dsc, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
     @pl.when(i == nq - 1)
@@ -426,19 +580,25 @@ def _blocks(s_len: int):
     return _pick_block(_BLOCK_Q, s_len), _pick_block(_BLOCK_K, s_len)
 
 
+def _blocks_fused(s_len: int):
+    return _pick_block(_BLOCK_Q_FUSED, s_len), _pick_block(_BLOCK_K_FUSED, s_len)
+
+
 @functools.lru_cache(maxsize=None)
 def _make(
     causal: bool, interpret: bool, scale: float, out_f32: bool = False,
-    stream: bool = False,
+    stream: bool = False, bf16_dots: bool = False,
 ):
     """Build the custom-VJP'd flash attention for a static (causal, mode,
-    scale, out-dtype, stream) tuple — scale is a trace-time constant folded
-    into the kernels, and the cache sees only a handful of distinct head
-    dims.  ``out_f32`` keeps the block output o in f32 regardless of input
-    dtype (the ring combine accumulates across blocks and must not round
-    each partial to bf16).  ``stream`` selects the tile-streaming kernels
-    (VMEM O(block*D) instead of O(S*D); chosen by the S·D dispatch in
-    :func:`flash_attention_lse`)."""
+    scale, out-dtype, stream, dot-precision) tuple — scale is a trace-time
+    constant folded into the kernels, and the cache sees only a handful of
+    distinct head dims.  ``out_f32`` keeps the block output o in f32
+    regardless of input dtype (the ring combine accumulates across blocks
+    and must not round each partial to bf16).  ``stream`` selects the
+    tile-streaming kernels (VMEM O(block*D) instead of O(S*D); chosen by
+    the S·D dispatch in :func:`flash_attention_lse`).  ``bf16_dots`` keeps
+    the MXU contractions in bf16 with f32 accumulation (set for bf16
+    inputs; see module docstring)."""
 
     def _forward_stream(q, k, v):
         from jax.experimental.pallas import tpu as pltpu
@@ -448,7 +608,7 @@ def _make(
         nk = s_len // bk
         kern = functools.partial(
             _fwd_stream_kernel, scale=scale, causal=causal, block_q=bq,
-            block_k=bk, nk=nk,
+            block_k=bk, nk=nk, bf16_dots=bf16_dots,
         )
         qrow = lambda b, i, j: (b, i, 0)  # noqa: E731
         krow = lambda b, i, j: (b, j, 0)  # noqa: E731
@@ -483,7 +643,8 @@ def _make(
         bh, s_len, d = q.shape
         bq, bk = _blocks(s_len)
         kern = functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+            _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+            bf16_dots=bf16_dots,
         )
         row = lambda b, i: (b, i, 0)  # noqa: E731
         full = lambda b, i: (b, 0, 0)  # noqa: E731
@@ -535,7 +696,7 @@ def _make(
         dq = pl.pallas_call(
             functools.partial(
                 _dq_stream_kernel, scale=scale, causal=causal, block_q=bq,
-                block_k=bk, nk=nk,
+                block_k=bk, nk=nk, bf16_dots=bf16_dots,
             ),
             grid=(bh, nq, nk),
             compiler_params=_sem("parallel", "parallel", "arbitrary"),
@@ -558,7 +719,7 @@ def _make(
         dk, dv = pl.pallas_call(
             functools.partial(
                 _dkv_stream_kernel, scale=scale, causal=causal, block_q=bq,
-                block_k=bk, nq=nq,
+                block_k=bk, nq=nq, bf16_dots=bf16_dots,
             ),
             grid=(bh, nk, nq),
             compiler_params=_sem("parallel", "parallel", "arbitrary"),
@@ -603,9 +764,46 @@ def _make(
         delta = delta - g_lse.astype(jnp.float32)
         row = lambda b, i: (b, i, 0)  # noqa: E731
         full = lambda b, i: (b, 0, 0)  # noqa: E731
+        if _fused_bwd_ok(
+            s_len, d, jnp.dtype(q.dtype).itemsize, bf16_dots, interpret
+        ):
+            # One pass: dK/dV accumulate into revisited f32 output blocks
+            # (VMEM-resident across the Q-tile grid dim, which must
+            # therefore be sequential) and are cast to the primal dtype
+            # outside — the same single end-rounding as the split path.
+            bq, bk = _blocks_fused(s_len)
+            dq, dk32, dv32 = pl.pallas_call(
+                functools.partial(
+                    _dqkv_kernel, scale=scale, causal=causal, block_q=bq,
+                    block_k=bk, bf16_dots=bf16_dots,
+                ),
+                grid=(bh, s_len // bq),
+                compiler_params=_sem("parallel", "arbitrary"),
+                in_specs=[
+                    pl.BlockSpec((1, bq, d), row),
+                    pl.BlockSpec((1, s_len, d), full),
+                    pl.BlockSpec((1, s_len, d), full),
+                    pl.BlockSpec((1, bq, d), row),
+                    pl.BlockSpec((1, bq, 1), row),
+                    pl.BlockSpec((1, bq, 1), row),
+                ],
+                out_specs=[
+                    pl.BlockSpec((1, bq, d), row),
+                    pl.BlockSpec((1, s_len, d), full),
+                    pl.BlockSpec((1, s_len, d), full),
+                ],
+                out_shape=[
+                    _out_struct(q.shape, q.dtype, q),
+                    _out_struct(k.shape, jnp.float32, k),
+                    _out_struct(v.shape, jnp.float32, v),
+                ],
+                interpret=interpret,
+            )(q, k, v, g, lse, delta)
+            return dq, dk32.astype(k.dtype), dv32.astype(v.dtype)
         dq = pl.pallas_call(
             functools.partial(
-                _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+                _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+                bf16_dots=bf16_dots,
             ),
             grid=(bh, s_len // bq),
             compiler_params=_sem("parallel", "parallel"),
@@ -623,7 +821,8 @@ def _make(
         )(q, k, v, g, lse, delta)
         dk, dv = pl.pallas_call(
             functools.partial(
-                _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+                _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+                bf16_dots=bf16_dots,
             ),
             grid=(bh, s_len // bk),
             compiler_params=_sem("parallel", "parallel"),
@@ -703,8 +902,19 @@ def flash_attention_lse(
     # VMEM, tile-streaming kernels beyond (lifts the round-2 S<=8k@D=128
     # single-chip ceiling; PDT_FLASH_FORCE_STREAM=1 forces streaming)
     stream = not _resident_ok(s_len, d)
+    # bf16-rate MXU dots for all-bf16 inputs (module docstring).  out_f32
+    # keeps f32 dots: its cotangent arrives f32 (ring combine path) and the
+    # cross-block combine is precision-sensitive by design.
+    import os
+
+    bf16_dots = (
+        not out_f32
+        and all(x.dtype == jnp.bfloat16 for x in (q, k, v))
+        and not os.environ.get("PDT_FLASH_F32_DOTS")
+    )
     out, lse = _make(
-        bool(causal), bool(interpret), float(scale), bool(out_f32), bool(stream)
+        bool(causal), bool(interpret), float(scale), bool(out_f32),
+        bool(stream), bool(bf16_dots),
     )(fold(q), fold(k), fold(v))
     out = jnp.swapaxes(out.reshape(b, h, s_len, d), 1, 2)
     lse = jnp.transpose(lse.reshape(b, h, s_len), (0, 2, 1))  # [B, S, H]

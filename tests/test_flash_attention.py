@@ -267,6 +267,115 @@ def test_streamed_lse_grad(force_stream):
         )
 
 
+# ----------------------------------------------------------------------
+# Fused backward (round-5: one pass produces dq/dk/dv, dK/dV accumulated
+# in revisited VMEM-resident f32 output blocks — the split two-pass path
+# remains for shapes whose fused footprint exceeds VMEM and as the
+# PDT_FLASH_NO_FUSED_BWD escape hatch).
+# ----------------------------------------------------------------------
+@pytest.fixture
+def split_bwd(monkeypatch):
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("PDT_FLASH_NO_FUSED_BWD", "1")
+    fa._make.cache_clear()
+    yield
+    fa._make.cache_clear()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fused_bwd_matches_split_bitwise(causal, dtype, split_bwd, monkeypatch):
+    """Fused and split backwards accumulate the same per-tile f32 values in
+    the same ascending order with one end-rounding each => bitwise-equal
+    grads, in both dot-precision modes (s=1536 runs multiple tile pairs
+    incl. the causal loop bounds on both sides).  The split path is pinned
+    to the fused path's tile pair: tile geometry determines f32 summation
+    ORDER, so bitwise equality is only defined at matching tiles (the
+    production defaults differ — fused halves the Q tile for scoped VMEM;
+    cross-tile agreement is covered by the naive-reference tolerances)."""
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_BLOCK_Q", fa._BLOCK_Q_FUSED)
+    monkeypatch.setattr(fa, "_BLOCK_K", fa._BLOCK_K_FUSED)
+    q, k, v = (x.astype(dtype) for x in _qkv(seed=11, s=1536))
+
+    def grads(q, k, v):
+        return jax.grad(
+            lambda q, k, v: jnp.sum(
+                jnp.sin(
+                    flash_attention(q, k, v, causal=causal, interpret=True)
+                    .astype(jnp.float32)
+                )
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    g_split = grads(q, k, v)
+    fa._make.cache_clear()
+    import os
+
+    del os.environ["PDT_FLASH_NO_FUSED_BWD"]
+    g_fused = grads(q, k, v)
+    for a, b, name in zip(g_split, g_fused, "qkv"):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            err_msg=f"d{name}",
+        )
+
+
+def test_fused_bwd_gate():
+    """The fused path must bow out for shapes whose K/V + f32 dK/dV blocks
+    exceed the VMEM budget (they fall back to the split resident or
+    streamed kernels)."""
+    from pytorch_distributed_training_tpu.ops.flash_attention import (
+        _fused_bwd_ok,
+    )
+
+    ok = lambda s, d, i: _fused_bwd_ok(s, d, i, bf16_dots=True, interpret=False)  # noqa: E731
+    assert ok(2048, 64, 2)  # the LM bench shape, bf16
+    assert ok(8192, 64, 2)
+    assert not ok(16384, 64, 2)  # resident edge: split path
+    assert not ok(8192, 128, 4)
+    # on real TPU, f32 dots overflow the fused kernel's scoped VMEM
+    assert not _fused_bwd_ok(2048, 64, 4, bf16_dots=False, interpret=False)
+    assert _fused_bwd_ok(2048, 64, 4, bf16_dots=False, interpret=True)
+
+
+def test_bf16_dots_grad_close_to_f32_dots():
+    """The bf16-MXU-rate dot path must track the f32-dot path on bf16
+    inputs (products are exact; p/ds round to bf16 before their dots) —
+    and PDT_FLASH_F32_DOTS must actually flip the path (observable via
+    a numeric difference in p@v rounding)."""
+    import os
+
+    from pytorch_distributed_training_tpu.ops import flash_attention as fa
+
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(seed=12, s=512))
+
+    def run():
+        fa._make.cache_clear()
+        return jax.value_and_grad(
+            lambda q: jnp.sum(
+                flash_attention(q, k, v, causal=True, interpret=True).astype(
+                    jnp.float32
+                )
+            )
+        )(q)
+
+    o_bf, g_bf = run()
+    os.environ["PDT_FLASH_F32_DOTS"] = "1"
+    try:
+        o_f32, g_f32 = run()
+    finally:
+        del os.environ["PDT_FLASH_F32_DOTS"]
+        fa._make.cache_clear()
+    np.testing.assert_allclose(float(o_bf), float(o_f32), rtol=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(g_bf, np.float32), np.asarray(g_f32, np.float32), atol=2e-1
+    )
+
+
 def test_gate_no_longer_caps_sequence():
     """flash_shapes_ok must accept sequences past the old resident-VMEM
     ceiling (S=8192@D=128) — those dispatch to the streamed kernels now."""

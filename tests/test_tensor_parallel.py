@@ -329,3 +329,70 @@ def test_zero3_sharded_params_match_plain():
                  "block0/mlp/fc2/kernel", "head/kernel"):
         assert uses_mesh_axis(flat_p[name].sharding, "data"), name
     assert uses_mesh_axis(flat_p["block0/attn/qkv/kernel"].sharding, "model")
+
+
+# ----------------------------------------------------------------------
+# GSPMD flash island (round 5, VERDICT r4 #2): with a mesh hint the
+# TP/ZeRO steps run Pallas flash attention inside a shard_map island
+# instead of the O(S^2) einsum.  Forced on the CPU mesh via
+# PDT_FLASH_GSPMD_INTERPRET; the oracle is the same single-device einsum
+# reference, so the island's resharding AND the kernel numerics are both
+# pinned.  Real-TPU throughput evidence: PERF.md round 5.
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("topology", ["tp4", "3d_sp2_tp2", "zero1_dp8"])
+def test_gspmd_flash_island_matches_single_device(topology, monkeypatch):
+    from pytorch_distributed_training_tpu.ops import attention as attn_mod
+    from pytorch_distributed_training_tpu.parallel import make_3d_mesh
+
+    monkeypatch.setenv("PDT_FLASH_GSPMD_INTERPRET", "1")
+    calls = []
+    real_island = attn_mod._gspmd_flash
+
+    def counting_island(*args, **kwargs):
+        calls.append(1)
+        return real_island(*args, **kwargs)
+
+    monkeypatch.setattr(attn_mod, "_gspmd_flash", counting_island)
+
+    seq = 128  # >= the flash gate's s % 128 == 0 minimum
+    rng = np.random.default_rng(21)
+    tokens_np = rng.integers(0, VOCAB, (BATCH, seq + 1)).astype(np.int32)
+    tokens, labels = jnp.asarray(tokens_np[:, :-1]), jnp.asarray(tokens_np[:, 1:])
+    opt = SGD(lr=0.05, momentum=0.9, weight_decay=1e-4)
+    lr_fn = multi_step_lr(0.05, [], 0.1)
+    model = TransformerLM(
+        vocab_size=VOCAB, max_len=seq, embed_dim=32, depth=2, num_heads=4,
+        seq_axis=None,
+    )
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def ref_loss(p):
+        logits = model.apply({"params": p}, tokens)
+        return cross_entropy_loss(logits.reshape(-1, VOCAB), labels.reshape(-1))
+
+    loss_ref, grads_ref = jax.value_and_grad(ref_loss)(params)
+    params_ref, _ = opt.update(grads_ref, opt.init(params), params, 0.05)
+    assert not calls  # reference path must NOT take the island
+
+    from pytorch_distributed_training_tpu.parallel.tensor import tp_state_shardings
+
+    mesh, zero = {
+        "tp4": (lambda: (make_mesh(model_parallelism=4), 0)),
+        "3d_sp2_tp2": (lambda: (make_3d_mesh(2, 2), 0)),
+        # the bench-measurable GSPMD config: pure ZeRO-1 at tp=1
+        "zero1_dp8": (lambda: (make_mesh(model_parallelism=1), 1)),
+    }[topology]()
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, tp_state_shardings(state, mesh, zero=zero))
+    step = build_tp_lm_train_step(model, opt, lr_fn, mesh, donate=False, zero=zero)(
+        state
+    )
+    state2, loss_tp = step(state, tokens, labels)
+
+    assert calls, "island was not taken"
+    assert np.isclose(float(loss_tp), float(loss_ref), atol=2e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params_ref),
+        jax.tree_util.tree_leaves(state2.params),
+    ):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-4)
