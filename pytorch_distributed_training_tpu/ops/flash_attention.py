@@ -910,7 +910,7 @@ def flash_attention_lse(
     bf16_dots = (
         not out_f32
         and all(x.dtype == jnp.bfloat16 for x in (q, k, v))
-        and not os.environ.get("PDT_FLASH_F32_DOTS")
+        and os.environ.get("PDT_FLASH_F32_DOTS", "0") == "0"
     )
     out, lse = _make(
         bool(causal), bool(interpret), float(scale), bool(out_f32),
