@@ -2,6 +2,7 @@
 attention shape (B4 H16 S2048 D64, bf16, causal, fwd+bwd).  Throwaway
 round-5 measurement helper; not part of the package."""
 import json
+import sys
 import os
 import time
 
@@ -75,6 +76,8 @@ for name, env, tiles in variants:
     grads[name] = (float(loss), g)
     print(json.dumps({"variant": name, "ms_per_op": results[name]}), flush=True)
 
+if "r4-split-f32dots" not in grads:
+    sys.exit("reference variant errored; no parity comparison possible")
 ref_l, ref_g = grads["r4-split-f32dots"]
 for name, (l, g) in grads.items():
     err = max(

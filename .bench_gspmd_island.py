@@ -1,0 +1,72 @@
+"""GSPMD-path LM step: einsum attention (PDT_FLASH_GSPMD=0) vs the flash
+island, same session.  Uses build_tp_lm_train_step with zero=1 on the
+single-chip mesh — the exact code path config/TransformerLM-fsdp.yml
+selects, at mesh size 1 so the delta is purely the attention impl.
+Throwaway round-5 measurement helper."""
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.engine import TrainState
+from pytorch_distributed_training_tpu.engine.tp_steps import (
+    build_tp_lm_train_step,
+)
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.optimizers import AdamW
+from pytorch_distributed_training_tpu.parallel import make_mesh
+from pytorch_distributed_training_tpu.parallel.tensor import tp_state_shardings
+from pytorch_distributed_training_tpu.schedulers import cosine_lr
+from pytorch_distributed_training_tpu.utils import enable_compile_cache
+
+enable_compile_cache(os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"))
+
+VOCAB, SEQ, BATCH, EMBED, DEPTH = 32768, 2048, 2, 1024, 16
+HEADS = int(os.environ.get("BENCH_LM_HEADS", "8"))
+
+lm = TransformerLM(
+    vocab_size=VOCAB, max_len=SEQ, embed_dim=EMBED, depth=DEPTH,
+    num_heads=HEADS, dtype=jnp.bfloat16,
+)
+opt = AdamW(lr=3e-4, weight_decay=0.1)
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :SEQ]))["params"]
+mesh = make_mesh(model_parallelism=1)
+lr_fn = cosine_lr(3e-4, 100000)
+inp, lab = jnp.asarray(tokens[:, :-1]), jnp.asarray(tokens[:, 1:])
+
+
+def run(tag):
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, tp_state_shardings(state, mesh, zero=1))
+    step = build_tp_lm_train_step(lm, opt, lr_fn, mesh, donate=False, zero=1)(state)
+    for _ in range(3):
+        state, loss = step(state, inp, lab)
+    float(loss)
+    iters = 20
+    best = None
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step(state, inp, lab)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    tok_s = BATCH * SEQ / best
+    print(
+        json.dumps({"variant": tag, "step_ms": round(best * 1e3, 1),
+                    "tokens_per_sec_chip": round(tok_s, 1),
+                    "final_loss": round(float(loss), 4)}),
+        flush=True,
+    )
+
+
+os.environ["PDT_FLASH_GSPMD"] = "0"
+run("zero1-einsum (r4 behavior)")
+os.environ["PDT_FLASH_GSPMD"] = "1"
+run("zero1-flash-island")

@@ -1,0 +1,156 @@
+"""Where does the fused flash backward's time go?  Timing-only kernel
+variants (math deliberately wrong where noted) at the LM attention shape.
+Throwaway round-5 measurement helper."""
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.ops import flash_attention as fa
+from jax.experimental import pallas as pl
+
+SHAPE = (4, 2048, 16, 64)
+
+
+def timed_grad(iters=40, windows=3):
+    fa._make.cache_clear()
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(SHAPE, np.float32), jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    def f(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True)
+        return (o.astype(jnp.float32) ** 2).mean()
+
+    grad_fn = jax.value_and_grad(f, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many(q, k, v):
+        def body(_, q_c):
+            _, (dq, dk, dv) = grad_fn(q_c, k, v)
+            return q_c + jnp.bfloat16(1e-3) * dq + jnp.bfloat16(1e-6) * (dk + dv)
+
+        return jnp.float32(jax.lax.fori_loop(0, iters, body, q)).sum()
+
+    float(many(q, k, v))
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(many(q, k, v))
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def timed_fwd(iters=40, windows=3):
+    fa._make.cache_clear()
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal(SHAPE, np.float32), jnp.bfloat16)
+        for _ in range(3)
+    )
+
+    @jax.jit
+    def many(q, k, v):
+        def body(_, q_c):
+            o = fa.flash_attention(q_c, k, v, causal=True)
+            return q_c + jnp.bfloat16(1e-3) * o
+
+        return jnp.float32(jax.lax.fori_loop(0, iters, body, q)).sum()
+
+    float(many(q, k, v))
+    best = None
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(many(q, k, v))
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+real_dqkv = fa._dqkv_kernel
+real_fwd = fa._fwd_kernel
+
+
+def dqkv_variant(mode):
+    def kern(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+             dq_ref, dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+             bf16_dots):
+        i = pl.program_id(1)
+        s_len = k_ref.shape[1]
+        nk = s_len // block_k
+
+        @pl.when(i == 0)
+        def _init():
+            dk_ref[...] = jnp.zeros(dk_ref.shape, dk_ref.dtype)
+            dv_ref[...] = jnp.zeros(dv_ref.shape, dv_ref.dtype)
+
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, :, 0]
+        delta = delta_ref[0, :, 0]
+        nj = jnp.minimum(nk, ((i + 1) * block_q + block_k - 1) // block_k)
+
+        def body(j, dq):
+            ks = pl.ds(j * block_k, block_k)
+            kb = k_ref[0, ks, :]
+            vb = v_ref[0, ks, :]
+            s = scale * jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if mode not in ("nomask", "matmul-floor"):
+                qg = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                kg = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(qg >= kg, s, fa._NEG)
+            if mode in ("noexp", "matmul-floor"):
+                p = s - lse[:, None]
+            else:
+                p = jnp.exp(s - lse[:, None])
+            pc = p.astype(jnp.bfloat16)
+            dv_ref[0, ks, :] = dv_ref[0, ks, :] + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if mode == "matmul-floor":
+                ds = dp
+            else:
+                ds = p * (dp - delta[:, None]) * scale
+            dsc = ds.astype(jnp.bfloat16)
+            dk_ref[0, ks, :] = dk_ref[0, ks, :] + jax.lax.dot_general(
+                dsc, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dq + jax.lax.dot_general(
+                dsc, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        d = q_ref.shape[-1]
+        dq = jax.lax.fori_loop(0, nj, body, jnp.zeros((block_q, d), jnp.float32))
+        dq_ref[0] = dq.astype(dq_ref.dtype)
+
+    return kern
+
+
+print(json.dumps({"fwd_only_ms": round(timed_fwd() * 1e3, 3)}), flush=True)
+print(json.dumps({"variant": "default", "ms": round(timed_grad() * 1e3, 3)}), flush=True)
+for mode in ("nomask", "noexp", "matmul-floor"):
+    fa._dqkv_kernel = dqkv_variant(mode)
+    try:
+        print(
+            json.dumps({"variant": mode, "ms": round(timed_grad() * 1e3, 3)}),
+            flush=True,
+        )
+    finally:
+        fa._dqkv_kernel = real_dqkv

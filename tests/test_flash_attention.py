@@ -316,7 +316,18 @@ def test_fused_bwd_matches_split_bitwise(causal, dtype, split_bwd, monkeypatch):
     import os
 
     del os.environ["PDT_FLASH_NO_FUSED_BWD"]
+    # guard against vacuous split==split: the second run must actually
+    # take the fused kernel
+    calls = []
+    real_kernel = fa._dqkv_kernel
+
+    def counting_kernel(*args, **kwargs):
+        calls.append(1)
+        return real_kernel(*args, **kwargs)
+
+    monkeypatch.setattr(fa, "_dqkv_kernel", counting_kernel)
     g_fused = grads(q, k, v)
+    assert calls, "fused path was not taken"
     for a, b, name in zip(g_split, g_fused, "qkv"):
         np.testing.assert_array_equal(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
@@ -373,6 +384,12 @@ def test_bf16_dots_grad_close_to_f32_dots():
     np.testing.assert_allclose(float(o_bf), float(o_f32), rtol=2e-2)
     np.testing.assert_allclose(
         np.asarray(g_bf, np.float32), np.asarray(g_f32, np.float32), atol=2e-1
+    )
+    # the flag must actually flip the path: p rounds to bf16 before the
+    # p@v dot only on the bf16-dots side, so bit-identical grads mean the
+    # escape hatch silently died (the cb874f2 bug class)
+    assert not np.array_equal(
+        np.asarray(g_bf, np.float32), np.asarray(g_f32, np.float32)
     )
 
 
