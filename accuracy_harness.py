@@ -161,18 +161,21 @@ def _normalize(u8: np.ndarray) -> np.ndarray:
     return ((u8.astype(np.float32) / 255.0) - IMAGENET_MEAN) / IMAGENET_STD
 
 
-def _shared_init_state_dict(seed: int = 0):
-    """torch-twin ResNet-18 init (torchvision init semantics) — the shared
-    starting point for BOTH trainers."""
+def _shared_init_state_dict(model_name: str = "ResNet18", seed: int = 0):
+    """torch-twin ResNet init (torchvision init semantics) — the shared
+    starting point for BOTH trainers.  ``model_name``: ResNet18 (basic
+    blocks) or ResNet50 (bottleneck, the reference's flagship recipe
+    /root/reference/config/ResNet50.yml)."""
     import sys
 
     import torch
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
-    from test_torch_port import TorchBasicBlock, TorchResNet
+    from test_torch_port import _TORCH_CONFIGS, TorchResNet
 
+    block, layers = _TORCH_CONFIGS[model_name]
     torch.manual_seed(seed)
-    tm = TorchResNet(TorchBasicBlock, [2, 2, 2, 2], num_classes=N_CLASSES)
+    tm = TorchResNet(block, layers, num_classes=N_CLASSES)
     return tm
 
 
@@ -188,7 +191,23 @@ def _recipe(iters: int):
 # ----------------------------------------------------------------------
 # Stage 3: this framework (compiled step on the default platform)
 # ----------------------------------------------------------------------
-def train_ours(stream_dir: str, iters: int, eval_every: int = 0, log=print):
+def train_ours(
+    stream_dir: str,
+    iters: int,
+    eval_every: int = 0,
+    log=print,
+    model_name: str = "ResNet18",
+    sync_bn: bool = False,
+):
+    """Train through this framework's compiled step.
+
+    ``sync_bn``: run the DP+SyncBN path — meaningful on a multi-device
+    mesh (the 8-virtual-device CPU mesh via JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count), where the batch shards over
+    ``data`` and BN moments cross the mesh in-graph (ops/batch_norm.py).
+    The DP==1dev convergence pin (VERDICT r4 #4) runs this twice on CPU:
+    once on 1 device, once on 8 with sync_bn, same streams.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -217,15 +236,22 @@ def train_ours(stream_dir: str, iters: int, eval_every: int = 0, log=print):
     batch = imgs.shape[1]
     rec = _recipe(iters)
 
-    model = get_model("ResNet18", num_classes=N_CLASSES)
+    from pytorch_distributed_training_tpu.parallel.mesh import DATA_AXIS
+
+    model = get_model(
+        model_name, num_classes=N_CLASSES,
+        axis_name=DATA_AXIS if sync_bn else None,
+    )
     mesh = make_mesh()
+    if sync_bn:
+        log(f"[ours] sync_bn over {mesh.devices.size} device(s)")
     opt = SGD(lr=rec["lr"], momentum=rec["momentum"], weight_decay=rec["weight_decay"])
     state = init_train_state(
         model, opt, jax.random.PRNGKey(0),
         jnp.zeros((1, IMAGE_SIZE, IMAGE_SIZE, 3)),
     )
     # shared torch init -> bitwise-identical starting weights
-    tm = _shared_init_state_dict()
+    tm = _shared_init_state_dict(model_name)
     variables = import_torch_resnet_state_dict(
         {"params": state.params, "batch_stats": state.batch_stats},
         tm.state_dict(),
@@ -235,7 +261,7 @@ def train_ours(stream_dir: str, iters: int, eval_every: int = 0, log=print):
     )
     state = jax.device_put(state, replicated_sharding(mesh))
     lr_fn = multi_step_lr(rec["lr"], rec["milestones"], rec["gamma"])
-    step = build_train_step(model, opt, lr_fn, mesh, sync_bn=False)
+    step = build_train_step(model, opt, lr_fn, mesh, sync_bn=sync_bn)
     eval_step = build_eval_step(model, mesh)
     img_sh = batch_sharding(mesh, 4)
     lab_sh = batch_sharding(mesh, 1)
@@ -273,7 +299,13 @@ def train_ours(stream_dir: str, iters: int, eval_every: int = 0, log=print):
 # ----------------------------------------------------------------------
 # Stage 4: torch reference-semantics trainer (CPU)
 # ----------------------------------------------------------------------
-def train_torch(stream_dir: str, iters: int, eval_every: int = 0, log=print):
+def train_torch(
+    stream_dir: str,
+    iters: int,
+    eval_every: int = 0,
+    log=print,
+    model_name: str = "ResNet18",
+):
     import torch
     import torch.nn.functional as F
 
@@ -285,7 +317,7 @@ def train_torch(stream_dir: str, iters: int, eval_every: int = 0, log=print):
     batch = imgs.shape[1]
     rec = _recipe(iters)
 
-    model = _shared_init_state_dict()
+    model = _shared_init_state_dict(model_name)
     model.train()
     optim = torch.optim.SGD(
         model.parameters(), lr=rec["lr"], momentum=rec["momentum"],
@@ -329,29 +361,70 @@ def train_torch(stream_dir: str, iters: int, eval_every: int = 0, log=print):
 
 
 # ----------------------------------------------------------------------
+# Generator parameters pinned into the stage done-markers (ADVICE r4 #3):
+# the cached dataset/streams are only reused when the parameters that
+# produced them match — changing N_CLASSES, per-class counts, IMAGE_SIZE,
+# or seeds rebuilds instead of silently reusing stale artifacts.
+_GEN_PARAMS = dict(
+    n_classes=N_CLASSES, per_class_train=200, per_class_val=40, size=96,
+    seed=0,
+)
+
+
+def _stream_params(iters: int, batch: int) -> dict:
+    # streams are a pure function of the generated dataset + (iters, batch,
+    # crop, seed), so the generator params fold in: a dataset rebuild must
+    # also invalidate streams derived from the old dataset
+    return dict(iters=iters, batch=batch, image_size=IMAGE_SIZE, seed=0,
+                gen=_GEN_PARAMS)
+
+
+def _stage_cached(done_path: str, params: dict, log, what: str) -> bool:
+    """True if the stage's done-marker exists AND records ``params``."""
+    if not os.path.exists(done_path):
+        return False
+    try:
+        recorded = json.loads(open(done_path).read())
+    except (ValueError, OSError):
+        recorded = None
+    if recorded != params:
+        log(f"[{what}] cached artifacts were built with {recorded}, "
+            f"need {params} — rebuilding")
+        return False
+    return True
+
+
 def run_all(work_dir: str, iters: int, batch: int = 64, eval_every: int = 0,
-            skip_torch: bool = False, log=print) -> dict:
+            skip_torch: bool = False, log=print,
+            model_name: str = "ResNet18", sync_bn: bool = False) -> dict:
     """gen -> streams -> ours -> torch; cached by directory contents."""
     data_root = os.path.join(work_dir, "data")
     stream_dir = os.path.join(work_dir, f"streams_i{iters}_b{batch}")
     # stage caching gates on DONE MARKERS written after the final flush, not
     # bare file existence — an interrupted generation leaves partial
     # artifacts (the stream memmap is created full-size before filling)
-    # that must be rebuilt, never silently reused
+    # that must be rebuilt, never silently reused; the marker records the
+    # generator parameters (ADVICE r4 #3)
     gen_done = os.path.join(data_root, ".done")
-    if not os.path.exists(gen_done):
+    if not _stage_cached(gen_done, _GEN_PARAMS, log, "gen"):
         log("[gen] building 40-class texture JPEG dataset...")
-        make_texture_dataset(data_root)
-        open(gen_done, "w").write("ok")
+        make_texture_dataset(data_root, **_GEN_PARAMS)
+        open(gen_done, "w").write(json.dumps(_GEN_PARAMS))
     stream_done = os.path.join(stream_dir, ".done")
-    if not os.path.exists(stream_done):
+    if not _stage_cached(stream_done, _stream_params(iters, batch), log, "streams"):
         log(f"[streams] precomputing {iters} x {batch} augmented batches...")
         precompute_streams(data_root, stream_dir, iters, batch)
-        open(stream_done, "w").write("ok")
-    ours = train_ours(stream_dir, iters, eval_every, log=log)
-    result = {"ours_top1": round(ours, 2), "iters": iters, "batch": batch}
+        open(stream_done, "w").write(json.dumps(_stream_params(iters, batch)))
+    ours = train_ours(
+        stream_dir, iters, eval_every, log=log, model_name=model_name,
+        sync_bn=sync_bn,
+    )
+    result = {"ours_top1": round(ours, 2), "iters": iters, "batch": batch,
+              "model": model_name}
     if not skip_torch:
-        ref = train_torch(stream_dir, iters, eval_every, log=log)
+        ref = train_torch(
+            stream_dir, iters, eval_every, log=log, model_name=model_name
+        )
         result["torch_top1"] = round(ref, 2)
         result["gap_pts"] = round(ours - ref, 2)
     return result
@@ -366,19 +439,27 @@ if __name__ == "__main__":
     ap.add_argument("--iters", type=int, default=2000)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--eval-every", type=int, default=250)
+    ap.add_argument("--model", default="ResNet18",
+                    choices=["ResNet18", "ResNet50"])
+    ap.add_argument("--sync-bn", action="store_true",
+                    help="ours: DP+SyncBN path (pair with JAX_PLATFORMS=cpu"
+                         " + an 8-virtual-device mesh for the DP==1dev pin)")
     args = ap.parse_args()
 
     work = args.work_dir
     data_root = os.path.join(work, "data")
     stream_dir = os.path.join(work, f"streams_i{args.iters}_b{args.batch}")
     if args.stage == "gen":
-        make_texture_dataset(data_root)
+        make_texture_dataset(data_root, **_GEN_PARAMS)
     elif args.stage == "streams":
         precompute_streams(data_root, stream_dir, args.iters, args.batch)
     elif args.stage == "ours":
-        train_ours(stream_dir, args.iters, args.eval_every)
+        train_ours(stream_dir, args.iters, args.eval_every,
+                   model_name=args.model, sync_bn=args.sync_bn)
     elif args.stage == "torch":
-        train_torch(stream_dir, args.iters, args.eval_every)
+        train_torch(stream_dir, args.iters, args.eval_every,
+                    model_name=args.model)
     else:
-        out = run_all(work, args.iters, args.batch, args.eval_every)
+        out = run_all(work, args.iters, args.batch, args.eval_every,
+                      model_name=args.model, sync_bn=args.sync_bn)
         print(json.dumps(out))

@@ -6,7 +6,13 @@ the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 from .profiling import TraceProfiler
 from .runner import Runner
 from .sp_steps import build_lm_eval_step, build_lm_train_step
-from .steps import TrainState, build_eval_step, build_train_step, init_train_state
+from .steps import (
+    TrainState,
+    build_eval_step,
+    build_eval_step_exact,
+    build_train_step,
+    init_train_state,
+)
 from .tp_steps import build_tp_lm_train_step
 
 __all__ = [
@@ -15,6 +21,7 @@ __all__ = [
     "TrainState",
     "build_train_step",
     "build_eval_step",
+    "build_eval_step_exact",
     "build_lm_train_step",
     "build_lm_eval_step",
     "build_tp_lm_train_step",

@@ -260,6 +260,21 @@ class Runner:
             len(self.val_loader),
         )
 
+        # Exact-count eval (``validation.exact: true``; beyond reference —
+        # masks the DistributedSampler wrap-padded tail + ragged-batch
+        # padding out of the in-graph psum, steps.build_eval_step_exact).
+        # Default off = reference parity (tail double-count, SURVEY §2.3).
+        self._exact_eval = bool(cfg.get("validation", {}).get("exact", False))
+        self._eval_step_exact = None
+        self._host_batch = host_batch
+        self._val_len = len(val_dataset)
+        self._val_n_hosts = n_hosts if self.distributed else 1
+        if self._exact_eval and self.is_lm:
+            self.logger.warning(
+                "validation.exact is implemented for the image eval path; "
+                "LM validation keeps the parity (per-batch meter) semantics"
+            )
+
         # --- mesh + compiled steps + sharded state (engine/paths.py) --------
         # Strategy table: the first matching PathSpec builds mesh, state,
         # train/eval steps, and the input shardings for this topology.
@@ -543,18 +558,33 @@ class Runner:
         self.scheduler.step()  # every iteration (:299)
 
     # ------------------------------------------------------------ validation
+    def _eval_state(self):
+        # with EMA enabled, validation runs on the averaged weights
+        return (
+            self.state.replace(params=self.state.ema)
+            if getattr(self, "ema_decay", None) is not None
+            else self.state
+        )
+
+    def _report_validation(self, loss, acc1, acc5):
+        if self.current_rank == 0:
+            self.logger.info(
+                "Acc@1: %.4f, Acc@5: %.4f, Loss: %.5f", acc1, acc5, loss
+            )
+            if self.tb_writer is not None:
+                self.tb_writer.add_scalar("eval/Acc@1", acc1, self.iter)
+                self.tb_writer.add_scalar("eval/Acc@5", acc5, self.iter)
+                self.tb_writer.add_scalar("eval/loss", loss, self.iter)
+
     def validate(self):
+        if self._exact_eval and not self.is_lm:
+            return self._validate_exact()
         if self.current_rank == 0:
             self.logger.info("Start valuation")
         loss_meter = AverageMeter()
         top_1 = AverageMeter()
         top_5 = AverageMeter()
-        # with EMA enabled, validation runs on the averaged weights
-        eval_state = (
-            self.state.replace(params=self.state.ema)
-            if getattr(self, "ema_decay", None) is not None
-            else self.state
-        )
+        eval_state = self._eval_state()
         for img, label in tqdm.tqdm(self.val_loader, disable=self.current_rank != 0):
             g_img, g_label = self._put_batch(img, label)
             loss, acc1, acc5 = self.eval_step(eval_state, g_img, g_label)
@@ -562,14 +592,47 @@ class Runner:
             loss_meter.update(float(loss))
             top_1.update(float(acc1))
             top_5.update(float(acc5))
+        self._report_validation(loss_meter.value(), top_1.value(), top_5.value())
+
+    def _validate_exact(self):
+        """Exact-count eval (``validation.exact``): per-sample sums with a
+        validity mask instead of per-batch meter averages — wrap-padded
+        tail samples and ragged-batch padding contribute nothing, so the
+        metrics equal the unsharded full-set computation exactly
+        (tests/test_engine.py::test_exact_eval_matches_unsharded)."""
+        from .steps import build_eval_step_exact
+
         if self.current_rank == 0:
-            self.logger.info(
-                "Acc@1: %.4f, Acc@5: %.4f, Loss: %.5f",
-                top_1.value(),
-                top_5.value(),
-                loss_meter.value(),
+            self.logger.info("Start valuation")
+        if self._eval_step_exact is None:
+            self._eval_step_exact = build_eval_step_exact(
+                self.model, self.mesh, input_norm=self._input_norm
             )
-            if self.tb_writer is not None:
-                self.tb_writer.add_scalar("eval/Acc@1", top_1.value(), self.iter)
-                self.tb_writer.add_scalar("eval/Acc@5", top_5.value(), self.iter)
-                self.tb_writer.add_scalar("eval/loss", loss_meter.value(), self.iter)
+        eval_state = self._eval_state()
+        # local position p maps to global sampler slot rank + n_hosts*p;
+        # wrap-padded duplicates occupy the slots past the dataset length
+        n_real = max(
+            0, -(-(self._val_len - self.current_rank) // self._val_n_hosts)
+        )
+        totals = np.zeros(4, np.float64)
+        seen = 0
+        for img, label in tqdm.tqdm(self.val_loader, disable=self.current_rank != 0):
+            label = np.asarray(label)
+            b = len(label)
+            # the loader wrap-pads its final chunk to full batch_size
+            # (data/loader.py, drop_last=False) — those duplicates occupy
+            # positions >= the sampler's local count, so the same position
+            # mask that covers sampler wrap-pads masks them too
+            assert b == self._host_batch, (b, self._host_batch)
+            mask = (np.arange(seen, seen + b) < n_real).astype(np.int32)
+            seen += b
+            g_img, g_label = self._put_batch(img, label)
+            g_mask = jax.make_array_from_process_local_data(
+                self._label_sharding, mask
+            )
+            sums = self._eval_step_exact(eval_state, g_img, g_label, g_mask)
+            totals += np.asarray([float(x) for x in sums])
+        n = max(totals[3], 1.0)
+        self._report_validation(
+            totals[0] / n, 100.0 * totals[1] / n, 100.0 * totals[2] / n
+        )

@@ -32,7 +32,13 @@ from ..metrics import accuracy
 from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
 
-__all__ = ["TrainState", "build_train_step", "build_eval_step", "init_train_state"]
+__all__ = [
+    "TrainState",
+    "build_train_step",
+    "build_eval_step",
+    "build_eval_step_exact",
+    "init_train_state",
+]
 
 
 class TrainState(struct.PyTreeNode):
@@ -269,5 +275,56 @@ def build_eval_step(model, mesh: Mesh, input_norm=None):
     @jax.jit
     def eval_step(state: TrainState, img, label):
         return sharded(state.params, state.batch_stats, img, label)
+
+    return eval_step
+
+
+def build_eval_step_exact(model, mesh: Mesh, input_norm=None):
+    """Exact-count distributed validation (``validation.exact: true``).
+
+    The parity eval (:func:`build_eval_step` + per-batch ``AverageMeter``)
+    inherits two reference biases on non-divisible val sets: the
+    ``DistributedSampler`` wrap-padded tail double-counts samples (torch
+    semantics, reference train_distributed.py:219-222) and the unweighted
+    per-batch meter over-weights a smaller final batch.  This step returns
+    GLOBAL SUMS ``(ce_sum, top1_sum, top5_sum, n)`` with a per-sample
+    validity mask folded in before the ``psum`` — masked samples (sampler
+    wrap-pads, runner batch-padding) contribute nothing, so
+    ``sums / n`` is exact for any val-set size.  Default remains the
+    parity eval (Runner.validate)."""
+    normalize = _input_normalizer(input_norm)
+
+    def body(params, batch_stats, img, label, mask):
+        img = normalize(img)
+        out = model.apply(
+            {"params": params, "batch_stats": batch_stats}, img, train=False
+        )
+        logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, label[:, None], axis=-1)[:, 0]
+        # k clamped like metrics.accuracy's argsort form: < 5 classes must
+        # not turn the exact flag into a trace-time crash
+        topk = jax.lax.top_k(out, min(5, out.shape[-1]))[1]
+        c1 = (topk[:, 0] == label).astype(jnp.float32)
+        c5 = jnp.any(topk == label[:, None], axis=-1).astype(jnp.float32)
+        m = mask.astype(jnp.float32)
+        return jax.lax.psum(
+            (jnp.sum(ce * m), jnp.sum(c1 * m), jnp.sum(c5 * m), jnp.sum(m)),
+            DATA_AXIS,
+        )
+
+    rep = P()
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            rep, rep, P(DATA_AXIS, None, None, None), P(DATA_AXIS),
+            P(DATA_AXIS),
+        ),
+        out_specs=(rep, rep, rep, rep),
+    )
+
+    @jax.jit
+    def eval_step(state: TrainState, img, label, mask):
+        return sharded(state.params, state.batch_stats, img, label, mask)
 
     return eval_step
