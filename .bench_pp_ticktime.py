@@ -1,0 +1,62 @@
+"""CPU-mesh PP step-time comparison for the stage-gated embed/head change.
+
+Shapes chosen so the head is a large share of a stage's per-tick FLOPs
+(vocab >> embed, shallow blocks), mirroring the TransformerLM-pp.yml
+regime the round-4 verdict called out.
+"""
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import time
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_training_tpu.engine.pp_steps import (
+    build_pp_lm_train_step,
+)
+from pytorch_distributed_training_tpu.engine.steps import TrainState
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.optimizers import AdamW
+from pytorch_distributed_training_tpu.parallel.pipeline import (
+    make_pp_mesh,
+    pp_stack_params,
+    pp_state_shardings,
+)
+
+VOCAB, EMBED, DEPTH, HEADS, SEQ = 8192, 256, 8, 4, 128
+BATCH, MICRO = 16, 4  # global batch; per data-shard 8, microbatch 2
+
+mesh = make_pp_mesh(4)  # (data=2, stage=4)
+lm = TransformerLM(vocab_size=VOCAB, max_len=SEQ, embed_dim=EMBED,
+                   depth=DEPTH, num_heads=HEADS, dtype=jnp.float32)
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, VOCAB, (BATCH, SEQ + 1)).astype(np.int32)
+params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :SEQ]))["params"]
+params = pp_stack_params(params, DEPTH)
+opt = AdamW(lr=1e-4)
+state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+state = jax.device_put(state, pp_state_shardings(state, mesh))
+inp = jnp.asarray(tokens[:, :-1])
+lab = jnp.asarray(tokens[:, 1:])
+
+import sys
+for sched in (sys.argv[1:] or ["gpipe", "1f1b"]):
+    step = build_pp_lm_train_step(
+        lm, opt, lambda _: jnp.float32(1e-4), mesh, MICRO, schedule=sched,
+        donate=False,
+    )(state)
+    st = state
+    for _ in range(2):
+        st, loss = step(st, inp, lab)
+    float(loss)
+    times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        for _ in range(3):
+            st, loss = step(st, inp, lab)
+        float(loss)
+        times.append((time.perf_counter() - t0) / 3)
+    print(f"{sched}: median step {np.median(times)*1e3:.1f} ms  "
+          f"(min {min(times)*1e3:.1f})  loss {float(loss):.4f}")

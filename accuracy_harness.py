@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 
 import numpy as np
@@ -407,11 +408,19 @@ def run_all(work_dir: str, iters: int, batch: int = 64, eval_every: int = 0,
     # generator parameters (ADVICE r4 #3)
     gen_done = os.path.join(data_root, ".done")
     if not _stage_cached(gen_done, _GEN_PARAMS, log, "gen"):
+        # wipe before rebuilding: the generator only ADDS files, so a
+        # parameter change (fewer images/classes) would otherwise leave
+        # stale JPEGs mixed into the "rebuilt" dataset — exactly the
+        # silent-staleness class the done-markers exist to prevent
+        if os.path.isdir(data_root):
+            shutil.rmtree(data_root)
         log("[gen] building 40-class texture JPEG dataset...")
         make_texture_dataset(data_root, **_GEN_PARAMS)
         open(gen_done, "w").write(json.dumps(_GEN_PARAMS))
     stream_done = os.path.join(stream_dir, ".done")
     if not _stage_cached(stream_done, _stream_params(iters, batch), log, "streams"):
+        if os.path.isdir(stream_dir):
+            shutil.rmtree(stream_dir)
         log(f"[streams] precomputing {iters} x {batch} augmented batches...")
         precompute_streams(data_root, stream_dir, iters, batch)
         open(stream_done, "w").write(json.dumps(_stream_params(iters, batch)))

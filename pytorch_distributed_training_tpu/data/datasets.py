@@ -314,10 +314,11 @@ class ImageFolderDataset:
             for fname in sorted(os.listdir(cdir)):
                 if fname.lower().endswith(_IMG_EXTS):
                     self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
-        # lazy dims memo as a compact int32 array (w==0 sentinel = unseen):
-        # a dict of tuples would cost ~200MB of Python objects at
-        # ImageNet's 1.28M samples; this is ~10MB
-        self._dims_cache = np.zeros((len(self.samples), 2), np.int32)
+        # dims memo allocated lazily on the first image_dims call (w==0
+        # sentinel = unseen); a dict of tuples would cost ~200MB of Python
+        # objects at ImageNet's 1.28M samples vs ~10MB for the array, and
+        # instances whose pixels flow through the pure-PIL path never pay it
+        self._dims_cache: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -333,7 +334,15 @@ class ImageFolderDataset:
         sits on the SERIAL path of the native batch pipeline (crop-box
         sampling happens in Python before the parallel C++ decode), so
         caching it cuts the Amdahl serial fraction of multi-core hosts
-        roughly in half from the second visit on (PERF.md round 4)."""
+        roughly in half from the second visit on (PERF.md round 4).
+
+        The speedup assumes crop-box sampling stays on a long-lived
+        main-process serial path (data/loader.py's native backend): forked
+        DataLoader workers each hold their own copy-on-write cache and
+        repopulate independently, and concurrent writers race benignly
+        (both write the same dims)."""
+        if self._dims_cache is None:
+            self._dims_cache = np.zeros((len(self.samples), 2), np.int32)
         w, h = self._dims_cache[idx]
         if w:
             return int(w), int(h)

@@ -29,6 +29,37 @@ import jax
 
 __all__ = ["Checkpointer"]
 
+# The layout-vs-corruption discrimination in ``_structure_differs`` relies
+# on an orbax contract that is conventional, not documented API: that
+# ``CheckpointManager.item_metadata(step)`` returns a pytree whose
+# flattened key paths mirror the SAVED state's tree structure.  Versions
+# this contract has been verified against (tests/test_checkpoint.py's
+# wrong-layout restores exercise it end to end).  Outside this range the
+# discriminator declines to classify (restore errors re-raise raw) instead
+# of risking a misdiagnosis on a changed metadata layout.
+_ORBAX_METADATA_CONTRACT_RANGE = ((0, 5, 0), (0, 12, 999))
+
+
+def _orbax_metadata_contract_ok(logger: Optional[logging.Logger] = None) -> bool:
+    import orbax.checkpoint as ocp
+
+    try:
+        ver = tuple(int(p) for p in ocp.__version__.split(".")[:3])
+    except (AttributeError, ValueError):
+        ver = None
+    lo, hi = _ORBAX_METADATA_CONTRACT_RANGE
+    ok = ver is not None and lo <= ver <= hi
+    if not ok and logger is not None:
+        logger.warning(
+            "orbax %s is outside the range %s..%s this framework's "
+            "checkpoint-layout discrimination was verified against; "
+            "automatic PP<->per-layer converting restore is disabled "
+            "(restore errors surface raw). Convert explicitly with "
+            "parallel.pipeline.pp_stack_params/pp_unstack_params if needed.",
+            getattr(ocp, "__version__", "<unknown>"), lo, hi,
+        )
+    return ok
+
 
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper keyed by iteration."""
@@ -147,7 +178,13 @@ class Checkpointer:
         """Whether the checkpoint's SAVED pytree structure differs from the
         target ``state``'s — from orbax item metadata, so the verdict does
         not depend on parsing error strings.  Unreadable metadata counts as
-        'no structural evidence' (False): the restore error re-raises."""
+        'no structural evidence' (False): the restore error re-raises.
+        Likewise when the installed orbax is outside the version range the
+        metadata contract was verified against (module docstring above):
+        a changed metadata tree layout must not read as 'wrong checkpoint
+        layout' when the real failure is corruption/IO."""
+        if not _orbax_metadata_contract_ok(logging.getLogger(__name__)):
+            return False
         try:
             meta = self._manager.item_metadata(step)
             return self._path_keys(meta) != self._path_keys(state)

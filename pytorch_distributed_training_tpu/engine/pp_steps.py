@@ -13,17 +13,20 @@ Design notes (TPU/XLA):
   - inter-stage transfer is a single ``ppermute`` per tick over the
     ``stage`` axis (nearest-neighbor ICI DMA), which XLA overlaps with the
     next tick's compute where the dependence allows.
-  - under SPMD every stage runs the same program, so embedding and head
-    math execute on all stages each tick and the unused results are masked
-    out.  The head is NOT negligible at large vocab (at the shipped
-    TransformerLM-pp.yml scale it is ~40% of a stage's per-tick FLOPs) —
-    but because stages advance in lockstep (each tick ends at the
-    ppermute), per-tick wall time is set by the last stage, which must pay
-    the head anyway; the redundant copies burn energy, not time.  The
-    standard remedy when it matters is rebalancing (fewer blocks on the
-    last stage), which the stacked-layer layout does not support yet.
-    What is never duplicated: the blocks — each stage applies only its own
-    layer shard.
+  - under SPMD every stage runs the same program TEXT, but embedding and
+    head math are gated by ``lax.cond`` on the (device-varying) stage
+    index, so only stage 0 executes the embed and only the last stage's
+    valid ticks execute the head+loss — XLA's conditional runs just the
+    taken branch at runtime.  The head is NOT negligible at large vocab
+    (at the shipped TransformerLM-pp.yml scale it is ~40% of a stage's
+    per-tick FLOPs): before round 5 every stage computed embed+head and
+    masked the results, putting embed+blocks+head on the lockstep critical
+    path; the conds cut that to max(embed+blocks, blocks+head) and
+    interior stages run blocks only.  The AD hazard and its resolution
+    (shared params pcast to stage-varying so the cotangent stage-psum
+    cannot land inside a single-stage branch) are documented at the cond
+    sites.  The blocks were never duplicated — each stage applies only
+    its own layer shard.
   - tick inputs are index-clipped to real microbatches (never garbage), so
     bubble ticks compute on valid data and masking alone guarantees
     correctness — no NaN-through-``where`` hazards.
@@ -285,18 +288,49 @@ def build_pp_lm_train_step(
         perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
 
         def loss_fn(p):
+            # Shared params are promoted to stage-varying BEFORE the conds
+            # below.  Without this, AD would place the stage-psum of their
+            # cotangent inside the cond branch (only the predicate-true
+            # stage executes it -> the other stages never join the
+            # all-reduce: deadlock).  After the pcast, only data/seq
+            # reductions remain inside the branches — safe, because every
+            # peer along those axes shares the same stage coordinate and
+            # takes the same branch — and the stage-psum runs once at the
+            # pcast transpose, outside the scan entirely.
+            shared = mark_varying(p["shared"], (STAGE_AXIS,))
+
             def tick(carry, xs):
                 x, loss_acc = carry
                 f_i, e_i, valid = xs
-                inj = embed(p["shared"], tok[f_i])
-                x_in = jnp.where(stage == 0, inj, x)
-                y = apply_blocks(p["blocks"], x_in)
-                logits = apply_head(p["shared"], y)
-                part = lm_loss_local(
-                    logits, lab[e_i], global_tokens, label_smoothing
-                )
                 is_last = stage == n_stages - 1
-                loss_acc = loss_acc + jnp.where(valid & is_last, part, 0.0)
+                # embed only on stage 0, head+loss only on the last stage's
+                # valid ticks: lax.cond with a device-varying predicate
+                # SKIPS the untaken branch at runtime, so interior stages
+                # run blocks only — the per-tick critical path drops from
+                # embed+blocks+head on every stage (the round-4 ~40%
+                # duplication) to max(embed+blocks, blocks+head).
+                x_in = jax.lax.cond(
+                    stage == 0,
+                    lambda: mark_varying(embed(shared, tok[f_i]), loss_axes),
+                    lambda: x,
+                )
+                y = apply_blocks(p["blocks"], x_in)
+
+                def head_loss():
+                    logits = apply_head(shared, y)
+                    return mark_varying(
+                        lm_loss_local(
+                            logits, lab[e_i], global_tokens, label_smoothing
+                        ),
+                        loss_axes,
+                    )
+
+                part = jax.lax.cond(
+                    valid & is_last,
+                    head_loss,
+                    lambda: mark_varying(jnp.float32(0.0), loss_axes),
+                )
+                loss_acc = loss_acc + part
                 x_next = jax.lax.ppermute(y, STAGE_AXIS, perm)
                 return (x_next, loss_acc), None
 
@@ -355,12 +389,35 @@ def build_pp_lm_train_step(
         )
 
         def stage_fn(p, tok_mb, lab_mb, x_recv):
-            inj = embed(p["shared"], tok_mb)
-            x_in = jnp.where(stage == 0, inj, x_recv)
+            # same cond-gating construction as grads_gpipe (see the comment
+            # there): shared params pcast to stage-varying FIRST so the
+            # AD-inserted stage-psum of their cotangent runs at the pcast
+            # transpose (every tick, all stages — the per-tick vjp below
+            # differentiates this whole function) instead of inside a
+            # branch only one stage takes
+            shared = mark_varying(p["shared"], (STAGE_AXIS,))
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: mark_varying(embed(shared, tok_mb), loss_axes),
+                lambda: x_recv,
+            )
             y = apply_blocks(p["blocks"], x_in)
-            logits = apply_head(p["shared"], y)
-            part = lm_loss_local(logits, lab_mb, global_tokens, label_smoothing)
-            return y, jnp.where(is_last, part, 0.0)
+
+            def head_loss():
+                logits = apply_head(shared, y)
+                return mark_varying(
+                    lm_loss_local(
+                        logits, lab_mb, global_tokens, label_smoothing
+                    ),
+                    loss_axes,
+                )
+
+            part = jax.lax.cond(
+                is_last,
+                head_loss,
+                lambda: mark_varying(jnp.float32(0.0), loss_axes),
+            )
+            return y, part
 
         def sel(row):
             return jnp.take(row, stage, axis=0)
@@ -494,6 +551,22 @@ def build_pp_lm_train_step(
                         lambda x: x.sharding,
                         getattr(state.opt_state, mirrors[0]),
                     )
+                    # the grad pin below assumes ONE moment layout; ZeRO
+                    # sharding applies uniformly to every params-mirroring
+                    # field (parallel/zero.py), so any disagreement means
+                    # the opt state was built inconsistently — fail loudly
+                    # here rather than pin grads to the wrong layout
+                    for m in mirrors[1:]:
+                        other = jax.tree.map(
+                            lambda x: x.sharding, getattr(state.opt_state, m)
+                        )
+                        if other != moment_sh:
+                            raise ValueError(
+                                f"ZeRO-2 x PP: opt-state field {m!r} is laid"
+                                f" out differently from {mirrors[0]!r}; all"
+                                " params-mirroring moment fields must share"
+                                " one ZeRO shard layout"
+                            )
 
             def step(state: TrainState, tokens, labels):
                 grads, loss = sharded_grads(state.params, tokens, labels)
@@ -599,20 +672,36 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int, seq_axis=Non
         def tick(carry, xs):
             x, loss_acc, c1, c5 = carry
             f_i, e_i, valid = xs
-            inj = embed(params["shared"], tok[f_i])
-            x_in = jnp.where(stage == 0, inj, x)
+            # same stage-gating as the train step (module docstring):
+            # forward-only, so no cotangent-psum hazard — plain conds
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: mark_varying(embed(params["shared"], tok[f_i]), red_axes),
+                lambda: x,
+            )
             y = apply_blocks(params["blocks"], x_in)
-            logits = apply_head(params["shared"], y)
-            part = lm_loss_local(logits, lab[e_i], global_tokens)
-            flat = logits.reshape(-1, logits.shape[-1])
-            flab = lab[e_i].reshape(-1)
-            top5 = jax.lax.top_k(flat, 5)[1]
-            hit1 = jnp.sum(top5[:, 0] == flab)
-            hit5 = jnp.sum(jnp.any(top5 == flab[:, None], axis=1))
+
+            def head_metrics():
+                logits = apply_head(params["shared"], y)
+                part = lm_loss_local(logits, lab[e_i], global_tokens)
+                flat = logits.reshape(-1, logits.shape[-1])
+                flab = lab[e_i].reshape(-1)
+                top5 = jax.lax.top_k(flat, 5)[1]
+                hit1 = jnp.sum(top5[:, 0] == flab)
+                hit5 = jnp.sum(jnp.any(top5 == flab[:, None], axis=1))
+                return mark_varying((part, hit1, hit5), red_axes)
+
             emit_mask = valid & (stage == n_stages - 1)
-            loss_acc = loss_acc + jnp.where(emit_mask, part, 0.0)
-            c1 = c1 + jnp.where(emit_mask, hit1, 0)
-            c5 = c5 + jnp.where(emit_mask, hit5, 0)
+            part, hit1, hit5 = jax.lax.cond(
+                emit_mask,
+                head_metrics,
+                lambda: mark_varying(
+                    (jnp.float32(0.0), jnp.int32(0), jnp.int32(0)), red_axes
+                ),
+            )
+            loss_acc = loss_acc + part
+            c1 = c1 + hit1
+            c5 = c5 + hit5
             x_next = jax.lax.ppermute(y, STAGE_AXIS, perm)
             return (x_next, loss_acc, c1, c5), None
 

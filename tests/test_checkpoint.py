@@ -293,6 +293,35 @@ def test_restore_nonstructural_error_not_misdiagnosed(tmp_path):
     )
 
 
+def test_orbax_metadata_contract_version_guard(monkeypatch):
+    """The layout-vs-corruption discriminator leans on orbax's (undocumented)
+    item_metadata tree-structure convention.  The installed orbax must be
+    inside the verified range, and outside it the discriminator must decline
+    to classify (return False -> raw restore errors re-raise) rather than
+    risk misreading a changed metadata layout as a checkpoint-layout
+    mismatch (round-4 VERDICT #8 / round-3 ADVICE #3)."""
+    import orbax.checkpoint as ocp
+
+    from pytorch_distributed_training_tpu.engine import checkpoint as ckpt_mod
+
+    # (a) the baked-in orbax is inside the verified range
+    assert ckpt_mod._orbax_metadata_contract_ok(), (
+        f"installed orbax {ocp.__version__} is outside "
+        f"{ckpt_mod._ORBAX_METADATA_CONTRACT_RANGE}; re-verify the "
+        "item_metadata contract (wrong-layout restore tests above) and "
+        "extend the range"
+    )
+
+    # (b) outside the range, _structure_differs declines without touching
+    # the manager (guard short-circuits before any metadata read)
+    monkeypatch.setattr(ocp, "__version__", "99.0.0")
+    assert not ckpt_mod._orbax_metadata_contract_ok()
+    differs = Checkpointer._structure_differs(
+        object.__new__(Checkpointer), 0, {"w": jnp.ones(2)}
+    )
+    assert differs is False
+
+
 # ----------------------------------------------------------------------
 # Cross-topology restore (round-3 VERDICT #6): a checkpoint written under
 # one parallelism layout must restore into another whenever the LOGICAL
