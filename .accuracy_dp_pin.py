@@ -1,0 +1,138 @@
+"""DP8+SyncBN == 1-device convergence pin (VERDICT r4 #4).
+
+Runs the accuracy-harness recipe (same streams, same ported torch init,
+milestones auto-scaled by ``_recipe``) through the framework's compiled
+step on EITHER one CPU device or the 8-virtual-device CPU mesh with
+SyncBN, and prints the final val top-1 plus a SHA-256 over the final
+params/batch-stats bytes.  The two invocations must agree: SyncBN's
+global-batch moments over 8 shards are the same math as 1-device BN over
+the unsharded batch, and the DP oracle (tests/test_engine.py::
+test_dp_step_matches_single_device) pins each step exactly — this script
+extends that to a full converged run.
+
+Usage:
+    XLA_FLAGS=--xla_force_host_platform_device_count=1 \
+        python .accuracy_dp_pin.py 1dev  --iters 400
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python .accuracy_dp_pin.py dp8   --iters 400
+"""
+import argparse
+import hashlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+import accuracy_harness as ah
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tag", choices=["1dev", "dp8"])
+    ap.add_argument("--iters", type=int, default=400)
+    ap.add_argument("--eval-every", type=int, default=100)
+    ap.add_argument("--stream-dir", default=".accuracy/streams_i2000_b64")
+    args = ap.parse_args()
+    sync_bn = args.tag == "dp8"
+
+    n_dev = jax.device_count()
+    expect = 8 if sync_bn else 1
+    assert n_dev == expect, (
+        f"{args.tag} needs {expect} devices, got {n_dev}; set "
+        f"XLA_FLAGS=--xla_force_host_platform_device_count={expect}"
+    )
+
+    from pytorch_distributed_training_tpu.engine import (
+        build_eval_step,
+        build_train_step,
+        init_train_state,
+    )
+    from pytorch_distributed_training_tpu.models import get_model
+    from pytorch_distributed_training_tpu.models.torch_port import (
+        import_torch_resnet_state_dict,
+    )
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.parallel.mesh import DATA_AXIS
+    from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+
+    imgs = np.load(os.path.join(args.stream_dir, "train_imgs.npy"), mmap_mode="r")
+    labels = np.load(os.path.join(args.stream_dir, "train_labels.npy"))
+    v_imgs = np.load(os.path.join(args.stream_dir, "val_imgs.npy"))
+    v_labs = np.load(os.path.join(args.stream_dir, "val_labels.npy"))
+    batch = imgs.shape[1]
+    rec = ah._recipe(args.iters)
+
+    model = get_model(
+        "ResNet18", num_classes=ah.N_CLASSES,
+        axis_name=DATA_AXIS if sync_bn else None,
+    )
+    mesh = make_mesh()
+    opt = SGD(lr=rec["lr"], momentum=rec["momentum"],
+              weight_decay=rec["weight_decay"])
+    state = init_train_state(
+        model, opt, jax.random.PRNGKey(0),
+        jnp.zeros((1, ah.IMAGE_SIZE, ah.IMAGE_SIZE, 3)),
+    )
+    tm = ah._shared_init_state_dict("ResNet18")
+    variables = import_torch_resnet_state_dict(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        tm.state_dict(),
+    )
+    state = state.replace(
+        params=variables["params"], batch_stats=variables["batch_stats"]
+    )
+    state = jax.device_put(state, replicated_sharding(mesh))
+    lr_fn = multi_step_lr(rec["lr"], rec["milestones"], rec["gamma"])
+    step = build_train_step(model, opt, lr_fn, mesh, sync_bn=sync_bn)
+    eval_step = build_eval_step(model, mesh)
+    img_sh = batch_sharding(mesh, 4)
+    lab_sh = batch_sharding(mesh, 1)
+
+    def evaluate(st):
+        accs, n = [], 0
+        for i in range(0, len(v_imgs), batch):
+            bi = ah._normalize(v_imgs[i:i + batch])
+            bl = v_labs[i:i + batch]
+            _, acc1, _ = eval_step(
+                st, jax.device_put(bi, img_sh), jax.device_put(bl, lab_sh)
+            )
+            accs.append(float(acc1) * len(bl))
+            n += len(bl)
+        return sum(accs) / n
+
+    t0 = time.perf_counter()
+    for it in range(args.iters):
+        g_img = jax.device_put(ah._normalize(np.asarray(imgs[it])), img_sh)
+        g_lab = jax.device_put(labels[it], lab_sh)
+        state, loss = step(state, g_img, g_lab)
+        if (it + 1) % args.eval_every == 0:
+            print(
+                f"[{args.tag}] iter {it + 1}/{args.iters} "
+                f"loss {float(loss):.6f}  "
+                f"({time.perf_counter() - t0:.0f}s)", flush=True,
+            )
+    top1 = evaluate(state)
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(
+        {"params": state.params, "batch_stats": state.batch_stats}
+    ):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    print(f"[{args.tag}] FINAL top1 {top1:.4f}  state_sha256 {h.hexdigest()}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

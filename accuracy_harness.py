@@ -397,10 +397,21 @@ def _stage_cached(done_path: str, params: dict, log, what: str) -> bool:
 
 def run_all(work_dir: str, iters: int, batch: int = 64, eval_every: int = 0,
             skip_torch: bool = False, log=print,
-            model_name: str = "ResNet18", sync_bn: bool = False) -> dict:
-    """gen -> streams -> ours -> torch; cached by directory contents."""
+            model_name: str = "ResNet18", sync_bn: bool = False,
+            stream_iters: int = 0) -> dict:
+    """gen -> streams -> ours -> torch; cached by directory contents.
+
+    ``stream_iters`` (default: ``iters``): length of the precomputed
+    stream — a shorter-horizon run (``iters`` < ``stream_iters``) trains
+    on the prefix of the longer stream, same pixels, no regeneration.
+    """
+    stream_iters = stream_iters or iters
+    if stream_iters < iters:
+        raise ValueError(
+            f"stream_iters {stream_iters} shorter than the {iters}-iter run"
+        )
     data_root = os.path.join(work_dir, "data")
-    stream_dir = os.path.join(work_dir, f"streams_i{iters}_b{batch}")
+    stream_dir = os.path.join(work_dir, f"streams_i{stream_iters}_b{batch}")
     # stage caching gates on DONE MARKERS written after the final flush, not
     # bare file existence — an interrupted generation leaves partial
     # artifacts (the stream memmap is created full-size before filling)
@@ -418,12 +429,12 @@ def run_all(work_dir: str, iters: int, batch: int = 64, eval_every: int = 0,
         make_texture_dataset(data_root, **_GEN_PARAMS)
         open(gen_done, "w").write(json.dumps(_GEN_PARAMS))
     stream_done = os.path.join(stream_dir, ".done")
-    if not _stage_cached(stream_done, _stream_params(iters, batch), log, "streams"):
+    if not _stage_cached(stream_done, _stream_params(stream_iters, batch), log, "streams"):
         if os.path.isdir(stream_dir):
             shutil.rmtree(stream_dir)
-        log(f"[streams] precomputing {iters} x {batch} augmented batches...")
-        precompute_streams(data_root, stream_dir, iters, batch)
-        open(stream_done, "w").write(json.dumps(_stream_params(iters, batch)))
+        log(f"[streams] precomputing {stream_iters} x {batch} augmented batches...")
+        precompute_streams(data_root, stream_dir, stream_iters, batch)
+        open(stream_done, "w").write(json.dumps(_stream_params(stream_iters, batch)))
     ours = train_ours(
         stream_dir, iters, eval_every, log=log, model_name=model_name,
         sync_bn=sync_bn,
@@ -453,15 +464,22 @@ if __name__ == "__main__":
     ap.add_argument("--sync-bn", action="store_true",
                     help="ours: DP+SyncBN path (pair with JAX_PLATFORMS=cpu"
                          " + an 8-virtual-device mesh for the DP==1dev pin)")
+    ap.add_argument("--stream-iters", type=int, default=None,
+                    help="length of the PRECOMPUTED stream to train from "
+                         "(default: --iters). Lets shorter-horizon runs "
+                         "(scaled recipes; the per-iter milestones come "
+                         "from --iters) reuse one long stream prefix — "
+                         "same pixels, no regeneration.")
     args = ap.parse_args()
 
     work = args.work_dir
     data_root = os.path.join(work, "data")
-    stream_dir = os.path.join(work, f"streams_i{args.iters}_b{args.batch}")
+    stream_iters = args.stream_iters or args.iters
+    stream_dir = os.path.join(work, f"streams_i{stream_iters}_b{args.batch}")
     if args.stage == "gen":
         make_texture_dataset(data_root, **_GEN_PARAMS)
     elif args.stage == "streams":
-        precompute_streams(data_root, stream_dir, args.iters, args.batch)
+        precompute_streams(data_root, stream_dir, stream_iters, args.batch)
     elif args.stage == "ours":
         train_ours(stream_dir, args.iters, args.eval_every,
                    model_name=args.model, sync_bn=args.sync_bn)
@@ -470,5 +488,6 @@ if __name__ == "__main__":
                     model_name=args.model)
     else:
         out = run_all(work, args.iters, args.batch, args.eval_every,
-                      model_name=args.model, sync_bn=args.sync_bn)
+                      model_name=args.model, sync_bn=args.sync_bn,
+                      stream_iters=stream_iters)
         print(json.dumps(out))

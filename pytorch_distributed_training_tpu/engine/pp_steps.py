@@ -15,9 +15,11 @@ Design notes (TPU/XLA):
     next tick's compute where the dependence allows.
   - under SPMD every stage runs the same program TEXT, but embedding and
     head math are gated by ``lax.cond`` on the (device-varying) stage
-    index, so only stage 0 executes the embed and only the last stage's
-    valid ticks execute the head+loss — XLA's conditional runs just the
-    taken branch at runtime.  The head is NOT negligible at large vocab
+    index, so only stage 0 executes the embed and only the last stage
+    executes the head+loss (in the gpipe scan and the eval step the head
+    gate additionally folds in tick validity; the 1F1B slots gate on the
+    stage index alone and mask the results per slot) — XLA's conditional
+    runs just the taken branch at runtime.  The head is NOT negligible at large vocab
     (at the shipped TransformerLM-pp.yml scale it is ~40% of a stage's
     per-tick FLOPs): before round 5 every stage computed embed+head and
     masked the results, putting embed+blocks+head on the lockstep critical
