@@ -24,7 +24,15 @@ from typing import Any, Dict
 
 import yaml
 
-__all__ = ["get_cfg", "get_train_logger", "get_tb_writer", "validate_cfg", "TB_SUBDIR"]
+__all__ = [
+    "get_cfg",
+    "get_serve_cfg",
+    "get_train_logger",
+    "get_tb_writer",
+    "validate_cfg",
+    "validate_serve_cfg",
+    "TB_SUBDIR",
+]
 
 # TensorBoard events live under <log_dir>/tf-board-logs: the reference's crash
 # handler intends to delete exactly this subdirectory (train_distributed.py:82;
@@ -69,6 +77,35 @@ def get_cfg(cfg_filepath: str) -> Dict[str, Any]:
     with open(cfg_filepath, "r") as fp:
         cfg = yaml.safe_load(fp)
     return validate_cfg(cfg, cfg_filepath)
+
+
+# Serving configs (config/serve-*.yml) reuse the training schema's
+# ``dataset`` / ``model`` sections (so a run's model block can be pasted
+# verbatim) but replace ``training`` with a ``serving`` section — none of
+# the optimizer/schedule keys apply.
+_REQUIRED_SERVE = {
+    "dataset": ["name", "n_classes"],
+    "model": ["name"],
+    "serving": [],
+}
+
+
+def validate_serve_cfg(cfg: Dict[str, Any], path: str = "<cfg>") -> Dict[str, Any]:
+    """Validate a serving config (see :mod:`..serving.engine` for keys)."""
+    for section, keys in _REQUIRED_SERVE.items():
+        if section not in cfg:
+            raise KeyError(f"{path}: missing required section '{section}'")
+        for key in keys:
+            if key not in cfg[section]:
+                raise KeyError(f"{path}: missing required key '{section}.{key}'")
+    return cfg
+
+
+def get_serve_cfg(cfg_filepath: str) -> Dict[str, Any]:
+    """Load + validate a serving YAML config."""
+    with open(cfg_filepath, "r") as fp:
+        cfg = yaml.safe_load(fp)
+    return validate_serve_cfg(cfg, cfg_filepath)
 
 
 def get_train_logger(logdir: str, filename: str, mode: str = "a") -> logging.Logger:

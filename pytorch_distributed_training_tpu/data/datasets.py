@@ -30,6 +30,7 @@ loader's worker threads (see loader.py).
 from __future__ import annotations
 
 import os
+import threading
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
@@ -317,11 +318,26 @@ class ImageFolderDataset:
         # dims memo allocated lazily on the first image_dims call (w==0
         # sentinel = unseen); a dict of tuples would cost ~200MB of Python
         # objects at ImageNet's 1.28M samples vs ~10MB for the array, and
-        # instances whose pixels flow through the pure-PIL path never pay it
+        # instances whose pixels flow through the pure-PIL path never pay it.
+        # The lock guards only the allocation: two threads hitting the
+        # first-use check together could each assign a fresh array, losing
+        # the other's dims writes (and the reader's view of them)
         self._dims_cache: Optional[np.ndarray] = None
+        self._dims_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.samples)
+
+    def __getstate__(self):
+        # locks don't pickle; workers start with an empty memo anyway
+        state = self.__dict__.copy()
+        state["_dims_lock"] = None
+        state["_dims_cache"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._dims_lock = threading.Lock()
 
     # Per-channel normalization applied at batch-assembly time by the
     # loader's fused native kernel (see data/loader.py + native/).
@@ -340,9 +356,14 @@ class ImageFolderDataset:
         main-process serial path (data/loader.py's native backend): forked
         DataLoader workers each hold their own copy-on-write cache and
         repopulate independently, and concurrent writers race benignly
-        (both write the same dims)."""
+        (both write the same dims) — but only once a single array exists,
+        hence the locked allocation."""
         if self._dims_cache is None:
-            self._dims_cache = np.zeros((len(self.samples), 2), np.int32)
+            with self._dims_lock:
+                if self._dims_cache is None:
+                    self._dims_cache = np.zeros(
+                        (len(self.samples), 2), np.int32
+                    )
         w, h = self._dims_cache[idx]
         if w:
             return int(w), int(h)

@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 from typing import Any, Optional, Tuple
 
 import jax
 
-__all__ = ["Checkpointer"]
+__all__ = ["Checkpointer", "load_serving_state"]
 
 # The layout-vs-corruption discrimination in ``_structure_differs`` relies
 # on an orbax contract that is conventional, not documented API: that
@@ -44,8 +45,17 @@ def _orbax_metadata_contract_ok(logger: Optional[logging.Logger] = None) -> bool
     import orbax.checkpoint as ocp
 
     try:
-        ver = tuple(int(p) for p in ocp.__version__.split(".")[:3])
+        # leading digits only: pre-release suffixes ("0.12.0rc1", "0.7.0.dev")
+        # must not disable the discriminator for an otherwise in-range
+        # version (ADVICE round 5) — int("0rc1") raised and read as
+        # "contract unverified"
+        ver = tuple(
+            int(re.match(r"\d+", p).group())
+            for p in ocp.__version__.split(".")[:3]
+        )
     except (AttributeError, ValueError):
+        # no __version__, a short version tuple, or a component with no
+        # leading digit at all — decline to classify, as before
         ver = None
     lo, hi = _ORBAX_METADATA_CONTRACT_RANGE
     ok = ver is not None and lo <= ver <= hi
@@ -338,3 +348,66 @@ class Checkpointer:
 
     def close(self) -> None:
         self._manager.close()
+
+
+def load_serving_state(
+    directory: str, logger: Optional[logging.Logger] = None
+) -> Tuple[Any, Any, int]:
+    """Restore the newest checkpoint's inference payload: ``(params,
+    batch_stats, step)``.
+
+    The serving side (:mod:`..serving.engine`) has no optimizer, so it cannot
+    build the abstract ``TrainState`` the training-time restore pins
+    shardings with; instead the checkpoint is read structure-free
+    (``StandardRestore()`` without a target tree — host arrays, placed by the
+    inference step's own jit) and only the forward-pass leaves are kept:
+    params, BN running stats, and — when the run trained with
+    ``training.ema`` — the EMA params, which replace the raw ones (the same
+    weights ``Runner.validate`` evaluates with).
+
+    Checkpoints written under ``training.pipeline_parallelism`` store params
+    in the stacked ``{blocks, shared}`` layout; those are converted back to
+    the per-layer tree ``TransformerLM.apply`` expects
+    (:func:`..parallel.pipeline.pp_unstack_params`).
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(os.path.expanduser(directory))
+    manager = ocp.CheckpointManager(directory)
+    try:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {directory} — train with "
+                "training.checkpoint.dir pointing there first, or serve "
+                "with serving.checkpoint unset (random-init smoke mode)"
+            )
+        restored = manager.restore(step, args=ocp.args.StandardRestore())
+    finally:
+        manager.close()
+    params = restored.get("params")
+    if params is None:
+        raise ValueError(
+            f"checkpoint at {directory} (iter {step}) has no 'params' tree"
+        )
+    batch_stats = restored.get("batch_stats") or {}
+    ema = restored.get("ema") or {}
+    if ema:
+        if logger:
+            logger.info(
+                "Serving the EMA params from %s (iter %d)", directory, step
+            )
+        params = ema
+    if isinstance(params, dict) and {"blocks", "shared"} <= set(params):
+        from ..parallel.pipeline import pp_unstack_params
+
+        depth = jax.tree.leaves(params["blocks"])[0].shape[0]
+        params = pp_unstack_params(params, depth)
+        if logger:
+            logger.info(
+                "Converted pipeline-layout checkpoint params to the "
+                "per-layer serving layout (depth %d)", depth
+            )
+    if logger:
+        logger.info("Restored serving params from %s (iter %d)", directory, step)
+    return params, batch_stats, step

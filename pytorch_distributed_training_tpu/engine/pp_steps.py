@@ -198,19 +198,23 @@ def _sim_1f1b(n_micro: int, n_stages: int):
 
 
 def _schedule(n_micro: int, n_stages: int):
-    """Static GPipe tick schedule: (feed index, emit index, emit mask).
+    """Static GPipe tick schedule: (feed index, feed mask, emit index,
+    emit mask).
 
-    Tick ``t``: stage 0 ingests microbatch ``t`` (clipped — re-feeding the
-    last microbatch during drain ticks keeps the data real), the last stage
-    finishes microbatch ``t - (S-1)``; its loss only counts once ``t`` has
-    passed the fill bubble.
+    Tick ``t``: stage 0 ingests microbatch ``t`` (clipped — the index stays
+    in range during drain ticks, but the feed mask goes false there so the
+    embed cond is skipped entirely rather than recomputed and discarded),
+    the last stage finishes microbatch ``t - (S-1)``; its loss only counts
+    once ``t`` has passed the fill bubble.
     """
     ticks = np.arange(n_micro + n_stages - 1)
     feed_idx = np.clip(ticks, 0, n_micro - 1)
+    feed_valid = ticks < n_micro
     emit_idx = np.clip(ticks - (n_stages - 1), 0, n_micro - 1)
     emit_valid = ticks >= n_stages - 1
     return (
         jnp.asarray(feed_idx, jnp.int32),
+        jnp.asarray(feed_valid),
         jnp.asarray(emit_idx, jnp.int32),
         jnp.asarray(emit_valid),
     )
@@ -269,7 +273,7 @@ def build_pp_lm_train_step(
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     embed, apply_blocks, apply_head = _stage_applies(model, seq_axis)
-    feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
+    feed_idx, feed_valid, emit_idx, emit_valid = _schedule(M, n_stages)
 
     def grads_gpipe(params, tokens, labels):
         b_local, seq = tokens.shape
@@ -303,16 +307,20 @@ def build_pp_lm_train_step(
 
             def tick(carry, xs):
                 x, loss_acc = carry
-                f_i, e_i, valid = xs
+                f_i, f_valid, e_i, valid = xs
                 is_last = stage == n_stages - 1
-                # embed only on stage 0, head+loss only on the last stage's
-                # valid ticks: lax.cond with a device-varying predicate
-                # SKIPS the untaken branch at runtime, so interior stages
-                # run blocks only — the per-tick critical path drops from
-                # embed+blocks+head on every stage (the round-4 ~40%
-                # duplication) to max(embed+blocks, blocks+head).
+                # embed only on stage 0's feed ticks, head+loss only on the
+                # last stage's valid ticks: lax.cond with a device-varying
+                # predicate SKIPS the untaken branch at runtime, so interior
+                # stages run blocks only — the per-tick critical path drops
+                # from embed+blocks+head on every stage (the round-4 ~40%
+                # duplication) to max(embed+blocks, blocks+head).  Folding
+                # feed validity in drops the S-1 drain-tick embeds whose
+                # output the clipped re-feed previously computed and threw
+                # away (their loss contribution was already masked, so
+                # gradients are unchanged).
                 x_in = jax.lax.cond(
-                    stage == 0,
+                    (stage == 0) & f_valid,
                     lambda: mark_varying(embed(shared, tok[f_i]), loss_axes),
                     lambda: x,
                 )
@@ -344,7 +352,7 @@ def build_pp_lm_train_step(
                 loss_axes,
             )
             (_, loss_sum), _ = jax.lax.scan(
-                tick, (x0, l0), (feed_idx, emit_idx, emit_valid)
+                tick, (x0, l0), (feed_idx, feed_valid, emit_idx, emit_valid)
             )
             # global mean CE as a replicated scalar: only the last stage
             # holds nonzero partials, the psum both totals them over data
@@ -657,7 +665,7 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int, seq_axis=Non
                 "microbatches %d; falling back to M=%d for this batch",
                 b_local, M_cfg, M,
             )
-        feed_idx, emit_idx, emit_valid = _schedule(M, n_stages)
+        feed_idx, feed_valid, emit_idx, emit_valid = _schedule(M, n_stages)
         mb = b_local // M
         if seq * n_seq > model.max_len:
             # same guard as the train bodies: beyond the table,
@@ -673,11 +681,11 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int, seq_axis=Non
 
         def tick(carry, xs):
             x, loss_acc, c1, c5 = carry
-            f_i, e_i, valid = xs
+            f_i, f_valid, e_i, valid = xs
             # same stage-gating as the train step (module docstring):
             # forward-only, so no cotangent-psum hazard — plain conds
             x_in = jax.lax.cond(
-                stage == 0,
+                (stage == 0) & f_valid,
                 lambda: mark_varying(embed(params["shared"], tok[f_i]), red_axes),
                 lambda: x,
             )
@@ -713,7 +721,7 @@ def build_pp_lm_eval_step(model, mesh: Mesh, num_microbatches: int, seq_axis=Non
             red_axes,
         )
         (_, loss_sum, c1, c5), _ = jax.lax.scan(
-            tick, carry0, (feed_idx, emit_idx, emit_valid)
+            tick, carry0, (feed_idx, feed_valid, emit_idx, emit_valid)
         )
         axes = red_axes
         loss = jax.lax.psum(loss_sum, axes)

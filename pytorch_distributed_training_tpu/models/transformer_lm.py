@@ -66,9 +66,15 @@ class DecoderBlock(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # KV-cache decode (serving/decode.py; see ops/attention.py): static
+    # flag + cache capacity, with the per-call position carried alongside
+    # the activations.  Params are unchanged, so train-time checkpoints
+    # serve directly.
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode_pos=None):
         dim = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + MultiHeadAttention(
@@ -78,8 +84,10 @@ class DecoderBlock(nn.Module):
             seq_impl=self.seq_impl,
             dtype=self.dtype,
             flash_mesh=self.flash_mesh,
+            decode=self.decode,
+            cache_len=self.cache_len,
             name="attn",
-        )(y)
+        )(y, decode_pos)
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.moe_experts > 0:
             from ..ops.moe import MoEMLP
@@ -133,11 +141,25 @@ class TransformerLM(nn.Module):
     # the O(S^2) einsum the partitioner would otherwise get.  Static
     # config only — parameter shapes/values are unchanged.
     flash_mesh: Optional[Any] = None
+    # KV-cache incremental decode (serving): ``model.clone(decode=True)``
+    # gives the serving-side module — same params, plus a "cache" variable
+    # collection of capacity ``max_len`` per block.  ``__call__`` with
+    # ``decode_pos=None`` is the prefill over the prompt; with ``decode_pos``
+    # ([B] int32 per-row positions) it consumes one token per row and
+    # returns its logits.  Mutually exclusive with seq_axis/MoE (serving is
+    # single-shard dense; enforced below).
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode_pos=None):
         if self.moe_experts > 0 and self.moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
+        if self.decode and self.seq_axis is not None:
+            raise ValueError("decode mode is single-shard: seq_axis must be None")
+        if self.decode and self.moe_experts > 0:
+            raise ValueError("decode mode does not support MoE blocks yet")
+        if decode_pos is not None and not self.decode:
+            raise ValueError("decode_pos given but model was not cloned with decode=True")
         b, s = tokens.shape
         emb = self.param(
             "tok_embedding",
@@ -152,7 +174,11 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         x = jnp.take(emb, tokens, axis=0).astype(self.dtype)
-        if self.seq_axis is not None and not self.is_initializing():
+        if decode_pos is not None:
+            # one new token per row at its own position: gather that row's
+            # position embedding instead of slicing a shared prefix
+            pe = jnp.take(pos, decode_pos, axis=0)[:, None]  # [B, 1, E]
+        elif self.seq_axis is not None and not self.is_initializing():
             # local shard i holds global positions [i*s, (i+1)*s)
             n_seq = jax.lax.psum(1, self.seq_axis)  # static axis size
             if s * n_seq > self.max_len:
@@ -163,10 +189,10 @@ class TransformerLM(nn.Module):
                     f" exceeds max_len {self.max_len}"
                 )
             off = jax.lax.axis_index(self.seq_axis) * s
-            pe = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)
+            pe = jax.lax.dynamic_slice_in_dim(pos, off, s, axis=0)[None]
         else:
-            pe = pos[:s]
-        x = x + pe[None].astype(self.dtype)
+            pe = pos[:s][None]
+        x = x + pe.astype(self.dtype)
         # remat (rematerialization): recompute block activations in the
         # backward pass instead of storing them — trades ~1/3 extra FLOPs
         # for O(depth) less activation HBM, the standard long-context lever
@@ -199,7 +225,9 @@ class TransformerLM(nn.Module):
                 flash_mesh=(
                     self.flash_mesh if not self.is_initializing() else None
                 ),
+                decode=self.decode,
+                cache_len=self.max_len if self.decode else 0,
                 name=f"block{i}",
-            )(x)
+            )(x, decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

@@ -196,9 +196,20 @@ class MultiHeadAttention(nn.Module):
     # mesh hint for the GSPMD flash island (engine/tp_steps sets it via
     # TransformerLM.flash_mesh); None = einsum under plain jit
     flash_mesh: Optional[Any] = None
+    # KV-cache incremental decode (serving/decode.py): ``decode=True``
+    # allocates ``cached_key``/``cached_value`` [B, cache_len, H, hd] in the
+    # "cache" variable collection.  A call with ``decode_pos=None`` is the
+    # PREFILL: normal causal attention over the prompt, cache rows [0, S)
+    # written as a side effect.  A call with ``decode_pos`` ([B] int32,
+    # per-row position of the single new token) is one DECODE STEP: k/v are
+    # scattered at each row's position and q attends over the whole cache
+    # masked to ``<= decode_pos`` — per-row positions support right-padded
+    # batches of different prompt lengths in one jit program.
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode_pos=None):
         b, s, dim = x.shape
         if dim % self.num_heads != 0:
             raise ValueError(f"embed dim {dim} not divisible by {self.num_heads} heads")
@@ -211,7 +222,9 @@ class MultiHeadAttention(nn.Module):
         # collectives (see parallel.tensor)
         qkv = qkv.reshape(b, s, self.num_heads, 3, head_dim)
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-        if self.seq_axis is None:
+        if self.decode:
+            out = self._decode_attention(q, k, v, decode_pos)
+        elif self.seq_axis is None:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, mesh=self.flash_mesh
             )
@@ -223,3 +236,51 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}")
         out = out.reshape(b, s, dim)
         return nn.Dense(dim, dtype=self.dtype, name="proj")(out)
+
+    def _decode_attention(self, q, k, v, decode_pos):
+        """Prefill / single-step attention against the KV cache."""
+        if self.seq_axis is not None:
+            raise ValueError("decode mode is single-shard (seq_axis must be None)")
+        if not self.causal:
+            raise ValueError("decode mode requires causal attention")
+        cache_len = self.cache_len
+        if cache_len <= 0:
+            raise ValueError(f"decode mode needs cache_len > 0, got {cache_len}")
+        b, s, num_heads, head_dim = q.shape
+        kv_shape = (b, cache_len, num_heads, head_dim)
+        cached_key = self.variable("cache", "cached_key", jnp.zeros, kv_shape, self.dtype)
+        cached_value = self.variable("cache", "cached_value", jnp.zeros, kv_shape, self.dtype)
+        if decode_pos is None:
+            # prefill: the prompt's k/v land in rows [0, S); attention over
+            # the prompt itself is the ordinary causal path.  Right-padded
+            # rows write garbage k/v beyond their true length, but each
+            # row's k/v depend only on that position's own token, so real
+            # positions are untouched — and decode steps overwrite the pad
+            # rows before any masked-in query ever reads them.
+            if s > cache_len:
+                raise ValueError(f"prompt length {s} exceeds cache_len {cache_len}")
+            cached_key.value = cached_key.value.at[:, :s].set(k.astype(self.dtype))
+            cached_value.value = cached_value.value.at[:, :s].set(v.astype(self.dtype))
+            return dot_product_attention(q, k, v, causal=True, impl="xla")
+        # single step: scatter this token's k/v at each row's position, then
+        # attend q over the full cache masked to the row's live prefix
+        if s != 1:
+            raise ValueError(f"decode step takes one token per row, got S={s}")
+        hit = (
+            jnp.arange(cache_len, dtype=jnp.int32)[None, :] == decode_pos[:, None]
+        )  # [B, L]
+        ck = jnp.where(hit[:, :, None, None], k.astype(self.dtype), cached_key.value)
+        cv = jnp.where(hit[:, :, None, None], v.astype(self.dtype), cached_value.value)
+        cached_key.value = ck
+        cached_value.value = cv
+        scale = 1.0 / math.sqrt(head_dim)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        ) * scale
+        live = (
+            jnp.arange(cache_len, dtype=jnp.int32)[None, :] <= decode_pos[:, None]
+        )  # [B, L]
+        logits = jnp.where(live[:, None, None, :], logits, float("-inf"))
+        p = jnp.asarray(nn.softmax(logits, axis=-1))
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        return out.astype(q.dtype)

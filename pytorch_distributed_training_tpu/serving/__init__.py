@@ -1,0 +1,27 @@
+"""Checkpoint-to-inference serving: the consumer side of the lifecycle.
+
+The training half of this repo ends at an orbax checkpoint; this package
+turns one into a low-latency batched service, applying the paper's central
+lever — saturate the accelerator by batching — to inference:
+
+  - :mod:`.engine`   — :class:`InferenceEngine`: restore, compile, serve.
+  - :mod:`.batcher`  — :class:`DynamicBatcher`: request queue with
+    max-batch-size / max-delay flush and per-request futures.
+  - :mod:`.decode`   — autoregressive generation over the KV-cache decode
+    mode of :class:`..models.transformer_lm.TransformerLM`.
+  - :mod:`.metrics`  — p50/p99 latency, queue depth, throughput.
+
+``python -m pytorch_distributed_training_tpu.serving --config
+config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
+"""
+from .batcher import DynamicBatcher
+from .decode import build_generate_fn
+from .engine import InferenceEngine
+from .metrics import ServingMetrics
+
+__all__ = [
+    "DynamicBatcher",
+    "InferenceEngine",
+    "ServingMetrics",
+    "build_generate_fn",
+]

@@ -1,0 +1,169 @@
+"""Dynamic micro-batching: the serving-side analog of large-batch training.
+
+Requests land on a queue and a single flush thread groups them into
+batches, releasing a batch when either (a) ``max_batch_size`` requests are
+waiting — the accelerator-saturation bound — or (b) the OLDEST waiting
+request has been queued for ``max_delay_ms`` — the latency bound.  Each
+``submit`` returns a ``concurrent.futures.Future`` resolved with that
+request's slice of the batch result (or its exception), so callers block
+only on their own request.
+
+The batcher is shape-agnostic: it hands the runner a list of
+``(payload, meta)`` pairs and the runner (``InferenceEngine._run_batch``)
+does the bucketing/padding, so the number of distinct XLA compiles stays
+bounded by the engine's bucket grid, not by client batch arithmetic.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+__all__ = ["DynamicBatcher", "Request"]
+
+
+class Request:
+    """One queued payload plus its result future and enqueue timestamp."""
+
+    __slots__ = ("payload", "meta", "future", "enqueued_at")
+
+    def __init__(self, payload, meta):
+        self.payload = payload
+        self.meta = dict(meta)
+        self.future: Future = Future()
+        self.enqueued_at = time.monotonic()
+
+
+class DynamicBatcher:
+    """Queue + flush thread grouping requests into bounded batches.
+
+    ``run_batch(requests)`` is called on the flush thread with 1..max_batch
+    requests and must return one result per request (same order); it may
+    instead set futures itself and return None.  Exceptions it raises are
+    propagated to every future in the batch.
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[Sequence[Request]], Optional[List[Any]]],
+        max_batch_size: int,
+        max_delay_ms: float,
+    ):
+        if max_batch_size < 1:
+            raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+        if max_delay_ms < 0:
+            raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        self._run_batch = run_batch
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay = max_delay_ms / 1000.0
+        self._queue: "queue.Queue[Optional[Request]]" = queue.Queue()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name="serving-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, payload, **meta) -> Future:
+        """Enqueue one request; the future resolves with its result."""
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        req = Request(payload, meta)
+        self._queue.put(req)
+        return req.future
+
+    def depth(self) -> int:
+        """Requests currently waiting (approximate, by nature)."""
+        return self._queue.qsize()
+
+    def close(self) -> None:
+        """Drain remaining requests, then stop the flush thread."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)  # sentinel wakes a blocked get
+        self._thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+
+    def _collect(self) -> Tuple[List[Request], bool]:
+        """Block for the first request, then gather until a flush trigger.
+
+        Returns ``(batch, stop)``; stop means the sentinel was seen (any
+        gathered batch is still flushed first — close() drains).
+        """
+        first = self._queue.get()
+        if first is None:
+            return [], True
+        batch = [first]
+        # a backlog that built while the previous batch ran must flush at
+        # full width immediately — grab whatever already waits before ever
+        # consulting the delay deadline (which the oldest request may well
+        # have passed by now; timing out to a singleton batch here would
+        # serialize the whole backlog one request at a time)
+        while len(batch) < self.max_batch_size:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                return batch, True
+            batch.append(req)
+        deadline = first.enqueued_at + self.max_delay
+        while len(batch) < self.max_batch_size:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                req = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if req is None:
+                return batch, True
+            batch.append(req)
+        return batch, False
+
+    def _flush(self, batch: List[Request]) -> None:
+        try:
+            results = self._run_batch(batch)
+        except BaseException as exc:  # propagate, don't kill the thread
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            return
+        if results is None:
+            return  # runner resolved the futures itself
+        if len(results) != len(batch):
+            exc = RuntimeError(
+                f"run_batch returned {len(results)} results for "
+                f"{len(batch)} requests"
+            )
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(exc)
+            return
+        for req, res in zip(batch, results):
+            if not req.future.done():
+                req.future.set_result(res)
+
+    def _loop(self) -> None:
+        while True:
+            batch, stop = self._collect()
+            if batch:
+                self._flush(batch)
+            if stop:
+                # drain anything enqueued before close() won the race
+                while True:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        return
+                    if req is not None:
+                        self._flush([req])

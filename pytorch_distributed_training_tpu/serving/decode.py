@@ -1,0 +1,113 @@
+"""Autoregressive generation over the TransformerLM KV-cache decode mode.
+
+One jit program per (batch bucket, sequence bucket): prefill the prompt
+batch in a single pass, then a ``lax.while_loop`` of single-token steps.
+The whole batch shares the program, but every row carries its own
+``prompt_len`` — prompts are right-padded to the bucket's sequence length
+and the per-row cache positions (ops/attention.py) keep padded rows exact.
+
+``while_loop`` rather than ``scan`` so a batch whose rows all hit EOS
+stops paying decode steps (the EOS early-exit of the ISSUE): the carry is
+scan-shaped, the trip count is data-dependent.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["build_generate_fn"]
+
+
+def build_generate_fn(
+    model,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    eos_id: Optional[int] = None,
+):
+    """Compile ``generate(params, tokens, prompt_len, rng)``.
+
+    ``model``: a :class:`..models.transformer_lm.TransformerLM` (decode
+    flag irrelevant — it is cloned with ``decode=True`` here).
+
+    Returns a jitted function mapping ``tokens`` [B, S] int32 (prompts
+    right-padded to S) and ``prompt_len`` [B] int32 (1 <= len <= S) to
+    ``(out_tokens [B, max_new_tokens] int32, gen_len [B] int32)`` where
+    ``gen_len`` counts valid generated tokens per row (including the EOS
+    token when one was produced); positions past ``gen_len`` are 0.
+
+    ``temperature == 0.0`` (static) is greedy argmax and ignores ``rng``;
+    otherwise tokens are drawn from ``softmax(logits / temperature)``.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    decode_model = model.clone(decode=True)
+    max_len = model.max_len
+
+    def sample(logits, rng):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
+
+    def hit_eos(tok):
+        if eos_id is None:
+            return jnp.zeros(tok.shape, bool)
+        return tok == eos_id
+
+    @jax.jit
+    def generate(params, tokens, prompt_len, rng):
+        b, s = tokens.shape
+        if s + max_new_tokens > max_len:
+            # the last generated token's position is prompt_len-1+max_new
+            # <= s-1+max_new; beyond the table the position gather would
+            # clamp and silently reuse rows (same guard as training)
+            raise ValueError(
+                f"seq bucket {s} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_len {max_len}"
+            )
+        prefill_logits, variables = decode_model.apply(
+            {"params": params}, tokens, mutable=["cache"]
+        )
+        cache = variables["cache"]
+        # the first generated token comes from the prefill logits at each
+        # row's last REAL position (right-padding means that is not s-1)
+        last = jnp.take_along_axis(
+            prefill_logits, (prompt_len - 1)[:, None, None], axis=1
+        )[:, 0]
+        rng, sub = jax.random.split(rng)
+        tok = sample(last, sub)
+        done = hit_eos(tok)
+        out = jnp.zeros((b, max_new_tokens), jnp.int32).at[:, 0].set(tok)
+        gen_len = jnp.ones((b,), jnp.int32)
+
+        def cond(carry):
+            i, _, _, _, done, _, _ = carry
+            return (i < max_new_tokens) & ~done.all()
+
+        def body(carry):
+            i, cache, prev, out, done, gen_len, rng = carry
+            # prev = generated token i-1, which sits at sequence position
+            # prompt_len + i - 1; feeding it yields the logits for token i
+            pos = prompt_len + i - 1
+            logits, variables = decode_model.apply(
+                {"params": params, "cache": cache},
+                prev[:, None],
+                jnp.minimum(pos, max_len - 1),
+                mutable=["cache"],
+            )
+            cache = variables["cache"]
+            rng, sub = jax.random.split(rng)
+            tok = sample(logits[:, 0], sub)
+            out = out.at[:, i].set(jnp.where(done, 0, tok))
+            gen_len = gen_len + jnp.where(done, 0, 1).astype(jnp.int32)
+            done = done | hit_eos(tok) | (pos + 1 >= max_len)
+            return (i + 1, cache, tok, out, done, gen_len, rng)
+
+        carry = (jnp.int32(1), cache, tok, out, done, gen_len, rng)
+        _, _, _, out, _, gen_len, _ = jax.lax.while_loop(cond, body, carry)
+        return out, gen_len
+
+    return generate

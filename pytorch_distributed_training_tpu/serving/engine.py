@@ -1,0 +1,298 @@
+"""InferenceEngine: orbax checkpoint -> compiled, batched inference.
+
+The serving mirror of ``engine/runner.py``: build the model from the same
+``model:`` config section a training run used, restore the forward-pass
+leaves of its checkpoint (:func:`..engine.checkpoint.load_serving_state`),
+and compile one jit program per shape bucket.  Requests of any size/length
+are padded UP to a bucket, so the number of XLA compiles is bounded by
+``len(batch_buckets) * len(seq_buckets)`` (classification: just
+``len(batch_buckets)``) no matter what traffic looks like — the serving
+analog of the fixed-shape training step.
+
+Batch buckets are rounded up to multiples of the mesh data-axis size so
+every program shards its batch the way the training step did
+(``parallel/mesh.py``); compute runs in the serving dtype (default bf16,
+the paper's mixed-precision stance) with f32 logits.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..engine.checkpoint import load_serving_state
+from ..engine.steps import _input_normalizer
+from ..models import get_model
+from ..parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from .batcher import DynamicBatcher, Request
+from .decode import build_generate_fn
+from .metrics import ServingMetrics
+
+__all__ = ["InferenceEngine"]
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class InferenceEngine:
+    """Restore a checkpoint and serve it through a dynamic batcher.
+
+    Use :meth:`from_config`; ``submit`` returns a future per request:
+
+      - LM (``TransformerLM``): payload is a 1-D int token prompt; result
+        ``{"tokens": int32 [gen_len], "gen_len": int}``.
+      - classification (ResNet/ViT): payload is one HWC image
+        (uint8, normalized in-graph; or pre-normalized float32); result
+        ``{"label": int, "logits": float32 [n_classes]}``.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        batch_stats,
+        mesh,
+        *,
+        is_lm: bool,
+        batch_buckets: Sequence[int],
+        seq_buckets: Sequence[int],
+        max_batch_size: int,
+        max_delay_ms: float,
+        max_new_tokens: int = 0,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        image_size: int = 0,
+        input_norm=None,
+        seed: int = 0,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.model = model
+        self.mesh = mesh
+        self.is_lm = is_lm
+        self.max_new_tokens = max_new_tokens
+        self.image_size = image_size
+        self.logger = logger or logging.getLogger(__name__)
+        self.metrics = ServingMetrics()
+        n_data = mesh.shape[DATA_AXIS]
+        self.batch_buckets = sorted({_round_up(b, n_data) for b in batch_buckets})
+        self.seq_buckets = sorted(set(int(s) for s in seq_buckets))
+        if is_lm:
+            if not self.seq_buckets:
+                raise ValueError("LM serving needs at least one seq bucket")
+            worst = self.seq_buckets[-1] + max_new_tokens
+            if worst > model.max_len:
+                raise ValueError(
+                    f"largest seq bucket {self.seq_buckets[-1]} + "
+                    f"max_new_tokens {max_new_tokens} = {worst} exceeds "
+                    f"model max_len {model.max_len}"
+                )
+            self._generate = build_generate_fn(
+                model, max_new_tokens, temperature=temperature, eos_id=eos_id
+            )
+        else:
+            normalize = _input_normalizer(input_norm)
+
+            @jax.jit
+            def classify(params, batch_stats, img):
+                img = normalize(img)
+                variables = {"params": params}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                return model.apply(variables, img, train=False)
+
+            self._classify = classify
+        # params live on-device replicated for the engine's lifetime — the
+        # per-batch device_put only moves the (small) padded inputs
+        rep = replicated_sharding(mesh)
+        self.params = jax.device_put(params, rep)
+        self.batch_stats = (
+            jax.device_put(batch_stats, rep) if batch_stats else {}
+        )
+        self._rng = jax.random.PRNGKey(seed)
+        self._batch_counter = 0
+        self.batcher = DynamicBatcher(
+            self._run_batch, max_batch_size, max_delay_ms
+        )
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any], logger=None) -> "InferenceEngine":
+        """Build from a ``serve-*.yml`` config (see config_parsing)."""
+        logger = logger or logging.getLogger(__name__)
+        serve = cfg["serving"]
+        dtype_name = serve.get("dtype", "bfloat16")
+        if dtype_name not in _DTYPES:
+            raise ValueError(
+                f"serving.dtype must be one of {sorted(_DTYPES)}, got {dtype_name!r}"
+            )
+        dtype = _DTYPES[dtype_name]
+        model_cfg = dict(cfg["model"])
+        model_name = model_cfg.pop("name")
+        is_lm = model_name.lower() == "transformerlm"
+        n_classes = cfg["dataset"]["n_classes"]
+        model = get_model(model_name, num_classes=n_classes, dtype=dtype, **model_cfg)
+
+        mesh = make_mesh()
+        ckpt_dir = serve.get("checkpoint")
+        image_size = int(cfg["dataset"].get("image_size", 224))
+        if ckpt_dir:
+            params, batch_stats, step = load_serving_state(ckpt_dir, logger)
+            logger.info("Serving %s from checkpoint iter %d", model_name, step)
+        else:
+            # smoke / bench mode: random init, loudly
+            logger.warning(
+                "serving.checkpoint not set — serving RANDOM-INIT %s "
+                "weights (smoke/bench mode only)", model_name
+            )
+            rng = jax.random.PRNGKey(int(serve.get("seed", 0)))
+            if is_lm:
+                seq = min(int(s) for s in serve.get("seq_buckets", [16]))
+                init_in = jnp.zeros((1, seq), jnp.int32)
+            else:
+                init_in = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+            variables = model.init(rng, init_in)
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+
+        max_batch = int(serve.get("max_batch_size", 8))
+        input_norm = None
+        if not is_lm and serve.get("normalize", True):
+            from ..data.datasets import IMAGENET_MEAN, IMAGENET_STD
+
+            input_norm = (IMAGENET_MEAN, IMAGENET_STD)
+        return cls(
+            model,
+            params,
+            batch_stats,
+            mesh,
+            is_lm=is_lm,
+            batch_buckets=serve.get("batch_buckets", [max_batch]),
+            seq_buckets=serve.get("seq_buckets", [16]),
+            max_batch_size=max_batch,
+            max_delay_ms=float(serve.get("max_delay_ms", 5.0)),
+            max_new_tokens=int(serve.get("max_new_tokens", 16)),
+            temperature=float(serve.get("temperature", 0.0)),
+            eos_id=serve.get("eos_id"),
+            image_size=image_size,
+            input_norm=input_norm,
+            seed=int(serve.get("seed", 0)),
+            logger=logger,
+        )
+
+    # ------------------------------------------------------------------ #
+
+    def submit(self, payload):
+        """Validate + enqueue one request; returns its result future."""
+        if self.is_lm:
+            prompt = np.asarray(payload, np.int32)
+            if prompt.ndim != 1 or prompt.size < 1:
+                raise ValueError(
+                    f"LM payload must be a non-empty 1-D token sequence, "
+                    f"got shape {prompt.shape}"
+                )
+            if prompt.size > self.seq_buckets[-1]:
+                raise ValueError(
+                    f"prompt length {prompt.size} exceeds largest seq "
+                    f"bucket {self.seq_buckets[-1]}"
+                )
+            return self.batcher.submit(prompt)
+        img = np.asarray(payload)
+        want = (self.image_size, self.image_size, 3)
+        if img.shape != want:
+            raise ValueError(f"image payload must have shape {want}, got {img.shape}")
+        return self.batcher.submit(img)
+
+    def depth(self) -> int:
+        return self.batcher.depth()
+
+    def compile_count(self) -> int:
+        """Number of distinct XLA programs compiled so far (<= bucket grid)."""
+        fn = self._generate if self.is_lm else self._classify
+        return fn._cache_size()
+
+    def close(self) -> None:
+        self.batcher.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+
+    def _bucket_for(self, n: int, buckets: Sequence[int], kind: str) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{kind} {n} exceeds largest bucket {buckets[-1]}")
+
+    def _next_rng(self):
+        self._batch_counter += 1
+        return jax.random.fold_in(self._rng, self._batch_counter)
+
+    def _run_batch(self, requests: List[Request]) -> List[Any]:
+        depth = self.batcher.depth()
+        if self.is_lm:
+            results = self._run_lm(requests)
+            n_items = int(sum(r["gen_len"] for r in results))
+        else:
+            results = self._run_images(requests)
+            n_items = len(results)
+        self.metrics.record_batch(
+            [r.enqueued_at for r in requests], n_items, depth
+        )
+        return results
+
+    def _run_lm(self, requests: List[Request]) -> List[Any]:
+        lens = [req.payload.size for req in requests]
+        bb = self._bucket_for(len(requests), self.batch_buckets, "batch size")
+        sb = self._bucket_for(max(lens), self.seq_buckets, "prompt length")
+        tokens = np.zeros((bb, sb), np.int32)
+        prompt_len = np.ones((bb,), np.int32)  # pad rows: 1-token dummy
+        for i, req in enumerate(requests):
+            tokens[i, : lens[i]] = req.payload
+            prompt_len[i] = lens[i]
+        tok_sh = batch_sharding(self.mesh, 2)
+        row_sh = batch_sharding(self.mesh, 1)
+        out, gen_len = self._generate(
+            self.params,
+            jax.device_put(tokens, tok_sh),
+            jax.device_put(prompt_len, row_sh),
+            self._next_rng(),
+        )
+        out = np.asarray(out)
+        gen_len = np.asarray(gen_len)
+        return [
+            {"tokens": out[i, : gen_len[i]], "gen_len": int(gen_len[i])}
+            for i in range(len(requests))
+        ]
+
+    def _run_images(self, requests: List[Request]) -> List[Any]:
+        bb = self._bucket_for(len(requests), self.batch_buckets, "batch size")
+        first = requests[0].payload
+        img = np.zeros((bb,) + first.shape, first.dtype)
+        for i, req in enumerate(requests):
+            img[i] = req.payload
+        logits = self._classify(
+            self.params,
+            self.batch_stats,
+            jax.device_put(img, batch_sharding(self.mesh, 4)),
+        )
+        logits = np.asarray(logits, np.float32)
+        return [
+            {"label": int(logits[i].argmax()), "logits": logits[i]}
+            for i in range(len(requests))
+        ]

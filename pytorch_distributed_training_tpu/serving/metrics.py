@@ -1,0 +1,87 @@
+"""Serving-side metrics: latency percentiles, throughput, batch shape.
+
+Latency is recorded per REQUEST (enqueue -> result set), so batching
+delay is included — the number a client actually observes.  Throughput
+counts work items (images for classification, generated tokens for LM)
+over the window from the first to the last recorded request.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Thread-safe accumulator; ``record_batch`` runs on the flush thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._latencies_ms: List[float] = []
+        self._batch_sizes: List[int] = []
+        self._items = 0
+        self._first_t: Optional[float] = None
+        self._last_t: Optional[float] = None
+        self._max_depth = 0
+
+    def record_batch(
+        self, enqueued_ats: List[float], n_items: int, queue_depth: int = 0
+    ) -> None:
+        """One flushed batch: per-request enqueue stamps + work-item count."""
+        now = time.monotonic()
+        with self._lock:
+            for t0 in enqueued_ats:
+                self._latencies_ms.append((now - t0) * 1000.0)
+            self._batch_sizes.append(len(enqueued_ats))
+            self._items += n_items
+            if self._first_t is None:
+                self._first_t = now
+            self._last_t = now
+            self._max_depth = max(self._max_depth, queue_depth)
+
+    def observe_depth(self, depth: int) -> None:
+        with self._lock:
+            self._max_depth = max(self._max_depth, depth)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Aggregate view: p50/p99 latency, items/sec, batch occupancy."""
+        with self._lock:
+            lat = np.asarray(self._latencies_ms, np.float64)
+            sizes = np.asarray(self._batch_sizes, np.float64)
+            span = (
+                (self._last_t - self._first_t)
+                if self._first_t is not None and self._last_t > self._first_t
+                else 0.0
+            )
+            items = self._items
+            depth = self._max_depth
+        out = {
+            "requests": int(lat.size),
+            "batches": int(sizes.size),
+            "items": int(items),
+            "max_queue_depth": int(depth),
+        }
+        if lat.size:
+            out["latency_ms_p50"] = float(np.percentile(lat, 50))
+            out["latency_ms_p99"] = float(np.percentile(lat, 99))
+            out["latency_ms_mean"] = float(lat.mean())
+        if sizes.size:
+            out["batch_size_mean"] = float(sizes.mean())
+        # open-loop throughput needs a time span; a single flush has none,
+        # so fall back to unreported rather than divide-by-zero noise
+        if span > 0:
+            out["items_per_sec"] = float(items / span)
+        return out
+
+    def log_summary(self, logger, prefix: str = "serving") -> Dict[str, float]:
+        snap = self.snapshot()
+        parts = ", ".join(
+            f"{k}={v:.2f}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in sorted(snap.items())
+        )
+        logger.info("%s metrics: %s", prefix, parts)
+        return snap

@@ -4,7 +4,11 @@ import os
 import pytest
 import yaml
 
-from pytorch_distributed_training_tpu.config_parsing import get_cfg, validate_cfg
+from pytorch_distributed_training_tpu.config_parsing import (
+    get_cfg,
+    get_serve_cfg,
+    validate_cfg,
+)
 
 GOOD = {
     "dataset": {"name": "synthetic", "root": "/tmp/x", "n_classes": 10},
@@ -35,13 +39,15 @@ def test_roundtrip(tmp_path):
 
 
 def test_reference_configs_validate():
-    """Our shipped configs follow the reference schema exactly."""
+    """Our shipped configs follow their schema exactly — training configs
+    the reference schema, ``serve-*.yml`` the serving one."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     cfg_dir = os.path.join(here, "config")
     names = sorted(n for n in os.listdir(cfg_dir) if n.endswith(".yml"))
     assert len(names) >= 8  # every shipped config is schema-validated
     for name in names:
-        cfg = get_cfg(os.path.join(cfg_dir, name))
+        loader = get_serve_cfg if name.startswith("serve-") else get_cfg
+        cfg = loader(os.path.join(cfg_dir, name))
         assert cfg["model"]["name"]
 
 

@@ -1,0 +1,383 @@
+"""Serving subsystem oracles (serving/ + the TransformerLM decode mode).
+
+The load-bearing test is decode parity: the KV-cache incremental path must
+reproduce the full-forward logits exactly (same math, fp32, CPU) including
+rows with DIFFERENT prompt lengths right-padded into one batch — the
+property the per-row cache positions (ops/attention.py) exist for.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.serving.batcher import DynamicBatcher
+from pytorch_distributed_training_tpu.serving.decode import build_generate_fn
+from pytorch_distributed_training_tpu.serving.metrics import ServingMetrics
+
+VOCAB = 61
+
+
+def small_lm(**kwargs):
+    return TransformerLM(
+        vocab_size=VOCAB, max_len=32, embed_dim=32, depth=2, num_heads=4, **kwargs
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    model = small_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+# --------------------------------------------------------------------- #
+# decode parity
+
+
+def test_decode_parity_incremental_matches_full(lm_and_params):
+    model, params = lm_and_params
+    toks = jax.random.randint(jax.random.PRNGKey(1), (3, 12), 0, VOCAB)
+    full = model.apply({"params": params}, toks)
+
+    dm = model.clone(decode=True)
+    prompt = 5
+    prefill, variables = dm.apply(
+        {"params": params}, toks[:, :prompt], mutable=["cache"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(prefill), np.asarray(full[:, :prompt]), rtol=2e-5, atol=2e-5
+    )
+    cache = variables["cache"]
+    for i in range(prompt, 12):
+        pos = jnp.full((3,), i, jnp.int32)
+        step, variables = dm.apply(
+            {"params": params, "cache": cache},
+            toks[:, i : i + 1],
+            pos,
+            mutable=["cache"],
+        )
+        cache = variables["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), np.asarray(full[:, i]), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_decode_parity_ragged_prompt_lengths(lm_and_params):
+    """Right-padded rows of different lengths in ONE batch stay exact."""
+    model, params = lm_and_params
+    rng = np.random.default_rng(2)
+    lens = [3, 7, 5]
+    pad_s = max(lens)
+    rows = [rng.integers(0, VOCAB, ln).astype(np.int32) for ln in lens]
+    batch = np.zeros((len(lens), pad_s), np.int32)
+    for i, row in enumerate(rows):
+        batch[i, : lens[i]] = row
+
+    dm = model.clone(decode=True)
+    prefill, variables = dm.apply(
+        {"params": params}, jnp.asarray(batch), mutable=["cache"]
+    )
+    cache = variables["cache"]
+    # continue each row from ITS OWN length with the same continuation token
+    cont = np.full((len(lens), 1), 9, np.int32)
+    pos = jnp.asarray(lens, jnp.int32)  # next position = prompt_len
+    step, _ = dm.apply(
+        {"params": params, "cache": cache}, jnp.asarray(cont), pos,
+        mutable=["cache"],
+    )
+    for i, ln in enumerate(lens):
+        # oracle: full forward over just this row's real tokens + cont
+        seq = np.concatenate([rows[i], [9]])[None]
+        full = model.apply({"params": params}, jnp.asarray(seq))
+        np.testing.assert_allclose(
+            np.asarray(step[i, 0]), np.asarray(full[0, ln]),
+            rtol=2e-5, atol=2e-5,
+        )
+        # and the prefill logits at the row's last real position match too
+        np.testing.assert_allclose(
+            np.asarray(prefill[i, ln - 1]), np.asarray(full[0, ln - 1]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_generate_greedy_matches_manual_argmax(lm_and_params):
+    """build_generate_fn's loop = repeated full-forward argmax continuation."""
+    model, params = lm_and_params
+    max_new = 4
+    gen = build_generate_fn(model, max_new_tokens=max_new, temperature=0.0)
+    rng = np.random.default_rng(3)
+    lens = [2, 6]
+    pad_s = 8
+    toks = np.zeros((2, pad_s), np.int32)
+    for i, ln in enumerate(lens):
+        toks[i, :ln] = rng.integers(0, VOCAB, ln)
+    out, gen_len = gen(
+        params, jnp.asarray(toks), jnp.asarray(lens, jnp.int32),
+        jax.random.PRNGKey(0),
+    )
+    out = np.asarray(out)
+    assert np.asarray(gen_len).tolist() == [max_new, max_new]  # no eos_id set
+    for i, ln in enumerate(lens):
+        seq = list(toks[i, :ln])
+        for j in range(max_new):
+            logits = model.apply(
+                {"params": params}, jnp.asarray([seq], jnp.int32)
+            )
+            nxt = int(np.asarray(logits)[0, -1].argmax())
+            assert out[i, j] == nxt, f"row {i} token {j}"
+            seq.append(nxt)
+
+
+def test_generate_eos_early_exit(lm_and_params):
+    """Rows report gen_len up to and including EOS; later slots are 0."""
+    model, params = lm_and_params
+    max_new = 6
+    toks = np.asarray([[4, 2, 0, 0]], np.int32)
+    lens = np.asarray([2], np.int32)
+    # find what greedy generates, then declare its SECOND token the EOS so
+    # the loop must stop at gen_len == 2
+    free = build_generate_fn(model, max_new_tokens=max_new, temperature=0.0)
+    out_free, _ = free(params, jnp.asarray(toks), jnp.asarray(lens),
+                       jax.random.PRNGKey(0))
+    eos = int(np.asarray(out_free)[0, 1])
+    gen = build_generate_fn(
+        model, max_new_tokens=max_new, temperature=0.0, eos_id=eos
+    )
+    out, gen_len = gen(params, jnp.asarray(toks), jnp.asarray(lens),
+                       jax.random.PRNGKey(0))
+    out, gen_len = np.asarray(out), np.asarray(gen_len)
+    assert gen_len[0] == 2
+    assert out[0, 1] == eos
+    assert not out[0, 2:].any()
+
+
+def test_decode_mode_rejects_seq_axis():
+    model = small_lm(seq_axis="sequence", decode=True)
+    with pytest.raises(ValueError, match="single-shard"):
+        model.apply({}, jnp.zeros((1, 4), jnp.int32), mutable=["cache"])
+
+
+# --------------------------------------------------------------------- #
+# batcher
+
+
+def test_batcher_flushes_on_size():
+    batches = []
+    done = threading.Event()
+
+    def run(reqs):
+        batches.append(len(reqs))
+        if sum(batches) >= 4:
+            done.set()
+        return [r.payload for r in reqs]
+
+    with DynamicBatcher(run, max_batch_size=4, max_delay_ms=10_000) as b:
+        futures = [b.submit(i) for i in range(4)]
+        assert [f.result(timeout=5) for f in futures] == [0, 1, 2, 3]
+        assert done.wait(timeout=5)
+    # the hour-long delay never elapsed: the size bound alone flushed
+    assert batches[0] == 4
+
+
+def test_batcher_flushes_on_deadline():
+    batches = []
+
+    def run(reqs):
+        batches.append(len(reqs))
+        return [r.payload for r in reqs]
+
+    with DynamicBatcher(run, max_batch_size=64, max_delay_ms=30) as b:
+        t0 = time.monotonic()
+        fut = b.submit("only")
+        assert fut.result(timeout=5) == "only"
+        waited = time.monotonic() - t0
+    assert batches == [1]
+    # flushed by the delay bound, far below any size-bound fill
+    assert waited < 5
+
+
+def test_batcher_propagates_exceptions():
+    def run(reqs):
+        raise RuntimeError("boom")
+
+    with DynamicBatcher(run, max_batch_size=2, max_delay_ms=1) as b:
+        fut = b.submit(0)
+        with pytest.raises(RuntimeError, match="boom"):
+            fut.result(timeout=5)
+
+
+def test_batcher_close_drains_queue():
+    seen = []
+
+    def run(reqs):
+        time.sleep(0.02)  # let a backlog build behind the first flush
+        seen.extend(r.payload for r in reqs)
+        return [None] * len(reqs)
+
+    b = DynamicBatcher(run, max_batch_size=2, max_delay_ms=1)
+    futures = [b.submit(i) for i in range(7)]
+    b.close()
+    for f in futures:
+        f.result(timeout=5)
+    assert sorted(seen) == list(range(7))
+
+
+# --------------------------------------------------------------------- #
+# engine: compile count bounded by the bucket grid
+
+
+@pytest.fixture(scope="module")
+def lm_engine():
+    from pytorch_distributed_training_tpu.serving.engine import InferenceEngine
+
+    cfg = {
+        "dataset": {"name": "synthetic_text", "n_classes": VOCAB},
+        "model": {
+            "name": "TransformerLM",
+            "embed_dim": 32,
+            "depth": 2,
+            "num_heads": 4,
+            "max_len": 32,
+        },
+        "serving": {
+            "dtype": "float32",
+            "max_batch_size": 4,
+            "max_delay_ms": 2,
+            "batch_buckets": [4],
+            "seq_buckets": [8, 16],
+            "max_new_tokens": 4,
+            "temperature": 0.0,
+        },
+    }
+    with InferenceEngine.from_config(cfg) as engine:
+        yield engine
+
+
+def test_engine_compile_count_bounded_by_buckets(lm_engine):
+    rng = np.random.default_rng(0)
+    futures = [
+        lm_engine.submit(rng.integers(0, VOCAB, ln).astype(np.int32))
+        for ln in (1, 3, 5, 8, 9, 11, 14, 16, 2, 13)  # both seq buckets,
+        # many distinct lengths and batch fills
+    ]
+    results = [f.result(timeout=120) for f in futures]
+    for res in results:
+        assert 1 <= res["gen_len"] <= 4
+        assert res["tokens"].shape == (res["gen_len"],)
+    # 1 batch bucket x 2 seq buckets => at most 2 XLA programs ever
+    assert lm_engine.compile_count() <= 2
+
+
+def test_engine_rejects_oversized_prompt(lm_engine):
+    with pytest.raises(ValueError, match="exceeds largest seq bucket"):
+        lm_engine.submit(np.zeros(17, np.int32))
+    with pytest.raises(ValueError, match="1-D"):
+        lm_engine.submit(np.zeros((2, 4), np.int32))
+
+
+def test_engine_bucket_overflow_guard():
+    from pytorch_distributed_training_tpu.serving.engine import InferenceEngine
+
+    cfg = {
+        "dataset": {"name": "synthetic_text", "n_classes": VOCAB},
+        "model": {"name": "TransformerLM", "embed_dim": 32, "depth": 1,
+                  "num_heads": 4, "max_len": 16},
+        "serving": {"dtype": "float32", "seq_buckets": [16],
+                    "max_new_tokens": 4},
+    }
+    with pytest.raises(ValueError, match="exceeds"):
+        InferenceEngine.from_config(cfg)
+
+
+# --------------------------------------------------------------------- #
+# checkpoint -> serving restore round-trip
+
+
+def test_load_serving_state_round_trip(tmp_path, lm_and_params):
+    from pytorch_distributed_training_tpu.engine.checkpoint import (
+        Checkpointer,
+        load_serving_state,
+    )
+    from pytorch_distributed_training_tpu.engine.steps import TrainState
+
+    model, params = lm_and_params
+    state = TrainState(
+        params=params, batch_stats={}, opt_state={}, ema={}
+    )
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), interval=1)
+    ckpt.save(7, state)
+    ckpt.wait()
+    ckpt.close()
+
+    restored, batch_stats, step = load_serving_state(str(tmp_path / "ckpt"))
+    assert step == 7
+    assert batch_stats == {}
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params,
+        restored,
+    )
+
+
+def test_load_serving_state_missing_dir(tmp_path):
+    from pytorch_distributed_training_tpu.engine.checkpoint import (
+        load_serving_state,
+    )
+
+    with pytest.raises(FileNotFoundError):
+        load_serving_state(str(tmp_path / "empty"))
+
+
+# --------------------------------------------------------------------- #
+# metrics + CLI
+
+
+def test_metrics_snapshot_percentiles():
+    m = ServingMetrics()
+    now = time.monotonic()
+    m.record_batch([now - 0.010, now - 0.020], n_items=8, queue_depth=3)
+    m.record_batch([now - 0.100], n_items=4, queue_depth=1)
+    snap = m.snapshot()
+    assert snap["requests"] == 3
+    assert snap["batches"] == 2
+    assert snap["items"] == 12
+    assert snap["max_queue_depth"] == 3
+    assert 9.0 <= snap["latency_ms_p50"] <= 105.0
+    assert snap["latency_ms_p50"] <= snap["latency_ms_p99"]
+    assert snap["latency_ms_p99"] <= 105.0  # largest recorded ~100ms
+
+
+def test_serving_cli_smoke(tmp_path, capsys):
+    """The acceptance-criteria round trip, in-process (fast: tiny model)."""
+    import json
+
+    from pytorch_distributed_training_tpu.serving.__main__ import main
+
+    cfg = tmp_path / "serve.yml"
+    cfg.write_text(
+        """
+dataset: {name: synthetic_text, n_classes: 61}
+model: {name: TransformerLM, embed_dim: 32, depth: 2, num_heads: 4, max_len: 32}
+serving:
+    dtype: float32
+    max_batch_size: 4
+    max_delay_ms: 2
+    seq_buckets: [8, 16]
+    max_new_tokens: 4
+"""
+    )
+    rc = main(
+        ["--config", str(cfg), "--requests", "8", "--log-dir", str(tmp_path)]
+    )
+    assert rc == 0
+    tail = capsys.readouterr().out.strip().splitlines()[-1]
+    snap = json.loads(tail)["serving"]
+    assert snap["requests"] == 8
+    assert snap["compile_count"] <= 2
+    assert snap["latency_ms_p50"] > 0
