@@ -10,6 +10,12 @@ the unsharded batch, and the DP oracle (tests/test_engine.py::
 test_dp_step_matches_single_device) pins each step exactly — this script
 extends that to a full converged run.
 
+The training loop itself IS ``accuracy_harness.train_ours`` (ADVICE r5
+#3: this file used to duplicate its ~80 setup/loop lines and could
+silently desynchronize from the harness it pins); this wrapper only adds
+the device-count assert, the per-tag log prefix, and the state hash from
+``return_state=True``.
+
 Usage:
     XLA_FLAGS=--xla_force_host_platform_device_count=1 \
         python .accuracy_dp_pin.py 1dev  --iters 400
@@ -20,14 +26,12 @@ import argparse
 import hashlib
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp
 import numpy as np
 
 import accuracy_harness as ah
@@ -49,81 +53,14 @@ def main():
         f"XLA_FLAGS=--xla_force_host_platform_device_count={expect}"
     )
 
-    from pytorch_distributed_training_tpu.engine import (
-        build_eval_step,
-        build_train_step,
-        init_train_state,
-    )
-    from pytorch_distributed_training_tpu.models import get_model
-    from pytorch_distributed_training_tpu.models.torch_port import (
-        import_torch_resnet_state_dict,
-    )
-    from pytorch_distributed_training_tpu.optimizers import SGD
-    from pytorch_distributed_training_tpu.parallel import (
-        batch_sharding,
-        make_mesh,
-        replicated_sharding,
-    )
-    from pytorch_distributed_training_tpu.parallel.mesh import DATA_AXIS
-    from pytorch_distributed_training_tpu.schedulers import multi_step_lr
+    def log(msg):
+        print(f"[{args.tag}] {msg}", flush=True)
 
-    imgs = np.load(os.path.join(args.stream_dir, "train_imgs.npy"), mmap_mode="r")
-    labels = np.load(os.path.join(args.stream_dir, "train_labels.npy"))
-    v_imgs = np.load(os.path.join(args.stream_dir, "val_imgs.npy"))
-    v_labs = np.load(os.path.join(args.stream_dir, "val_labels.npy"))
-    batch = imgs.shape[1]
-    rec = ah._recipe(args.iters)
-
-    model = get_model(
-        "ResNet18", num_classes=ah.N_CLASSES,
-        axis_name=DATA_AXIS if sync_bn else None,
+    top1, state = ah.train_ours(
+        args.stream_dir, args.iters, eval_every=args.eval_every, log=log,
+        model_name="ResNet18", sync_bn=sync_bn, return_state=True,
+        eval_in_loop=False,  # the pin compares only the FINAL state
     )
-    mesh = make_mesh()
-    opt = SGD(lr=rec["lr"], momentum=rec["momentum"],
-              weight_decay=rec["weight_decay"])
-    state = init_train_state(
-        model, opt, jax.random.PRNGKey(0),
-        jnp.zeros((1, ah.IMAGE_SIZE, ah.IMAGE_SIZE, 3)),
-    )
-    tm = ah._shared_init_state_dict("ResNet18")
-    variables = import_torch_resnet_state_dict(
-        {"params": state.params, "batch_stats": state.batch_stats},
-        tm.state_dict(),
-    )
-    state = state.replace(
-        params=variables["params"], batch_stats=variables["batch_stats"]
-    )
-    state = jax.device_put(state, replicated_sharding(mesh))
-    lr_fn = multi_step_lr(rec["lr"], rec["milestones"], rec["gamma"])
-    step = build_train_step(model, opt, lr_fn, mesh, sync_bn=sync_bn)
-    eval_step = build_eval_step(model, mesh)
-    img_sh = batch_sharding(mesh, 4)
-    lab_sh = batch_sharding(mesh, 1)
-
-    def evaluate(st):
-        accs, n = [], 0
-        for i in range(0, len(v_imgs), batch):
-            bi = ah._normalize(v_imgs[i:i + batch])
-            bl = v_labs[i:i + batch]
-            _, acc1, _ = eval_step(
-                st, jax.device_put(bi, img_sh), jax.device_put(bl, lab_sh)
-            )
-            accs.append(float(acc1) * len(bl))
-            n += len(bl)
-        return sum(accs) / n
-
-    t0 = time.perf_counter()
-    for it in range(args.iters):
-        g_img = jax.device_put(ah._normalize(np.asarray(imgs[it])), img_sh)
-        g_lab = jax.device_put(labels[it], lab_sh)
-        state, loss = step(state, g_img, g_lab)
-        if (it + 1) % args.eval_every == 0:
-            print(
-                f"[{args.tag}] iter {it + 1}/{args.iters} "
-                f"loss {float(loss):.6f}  "
-                f"({time.perf_counter() - t0:.0f}s)", flush=True,
-            )
-    top1 = evaluate(state)
 
     h = hashlib.sha256()
     for leaf in jax.tree.leaves(

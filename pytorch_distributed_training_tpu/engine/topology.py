@@ -169,6 +169,39 @@ def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
         raise ValueError(
             "training.zero is only wired for the LM task (GSPMD path)"
         )
+    # Additive key ``training.remat``: rematerialization policy for the
+    # transformer blocks — ``none`` (default), ``block`` (full recompute,
+    # nn.remat with nothing saveable), ``dots`` / ``dots_saveable``
+    # (jax.checkpoint_policies: save matmul outputs, recompute
+    # elementwise; ``dots_saveable`` additionally saves batch-dim dots
+    # like attention scores).  A TRAINING-section alias of the model-level
+    # ``model.remat``/``model.remat_policy`` pair so memory/recompute
+    # sweeps live next to batch size in the recipe; setting both is a
+    # loud conflict rather than a silent precedence rule.
+    remat_cfg = train_cfg.get("remat", None)
+    if remat_cfg is not None:
+        if not r.is_lm:
+            raise ValueError(
+                "training.remat is only wired for the LM task "
+                "(model.name: TransformerLM)"
+            )
+        if "remat" in model_cfg or "remat_policy" in model_cfg:
+            raise ValueError(
+                "set either training.remat or model.remat/"
+                "model.remat_policy, not both"
+            )
+        remat_map = {
+            "none": (False, "nothing"),
+            "block": (True, "nothing"),
+            "dots": (True, "dots"),
+            "dots_saveable": (True, "dots_saveable"),
+        }
+        if remat_cfg not in remat_map:
+            raise ValueError(
+                f"training.remat must be one of {sorted(remat_map)}, "
+                f"got {remat_cfg!r}"
+            )
+        model_cfg["remat"], model_cfg["remat_policy"] = remat_map[remat_cfg]
     if r.zero >= 3 and r.pipe_par > 1:
         # FSDP-scattered params would need a stage-stacked scattered
         # layout inside the manual shard_map — not wired (ZeRO-1/2 do

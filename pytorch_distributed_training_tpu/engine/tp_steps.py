@@ -162,6 +162,14 @@ def build_tp_lm_train_step(
             if zero >= 2:
                 grads = shard_grads(grads)
         lr = lr_fn(state.opt_state.step)
+        # A `fused=True` optimizer composes with ZeRO here unchanged: this is
+        # GSPMD (not shard_map), so the concatenated flat update buffers are
+        # ordinary ops on sharded arrays and XLA's sharding propagation
+        # chooses the layout — the cross-replica sharded weight update of
+        # arXiv:2004.13336 expressed declaratively.  Bitwise parity with the
+        # per-leaf path is pinned in tests/test_profiling.py (incl. a ZeRO-1
+        # GSPMD case); whether concat beats per-leaf under ZeRO is a chip
+        # measurement (`bench.py decompose`), not an assumption.
         new_params, new_opt = optimizer.update(grads, state.opt_state, state.params, lr)
         return (
             TrainState(

@@ -41,6 +41,10 @@ def resolve_remat_policy(name: str):
         # matmul outputs saved, elementwise recomputed: +8.6% tokens/sec
         # for remat runs on the bench chip (PERF.md round 4)
         "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # every dot saved (incl. batch dims — attention scores too):
+        # more memory than "dots", less recompute; the third point on the
+        # memory/recompute curve for training.remat sweeps
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
     }
     if name not in policies:
         raise ValueError(
@@ -72,12 +76,16 @@ class DecoderBlock(nn.Module):
     # serve directly.
     decode: bool = False
     cache_len: int = 0
+    # Fuse the residual-add+ln2 and fc1-bias+gelu elementwise tails into
+    # single Pallas kernels (ops/fused_elementwise.py).  Same parameter
+    # tree either way (checkpoint-compatible); off by default.
+    fused_tails: bool = False
 
     @nn.compact
     def __call__(self, x, decode_pos=None):
         dim = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        x = x + MultiHeadAttention(
+        attn_out = MultiHeadAttention(
             num_heads=self.num_heads,
             causal=True,
             seq_axis=self.seq_axis,
@@ -88,7 +96,18 @@ class DecoderBlock(nn.Module):
             cache_len=self.cache_len,
             name="attn",
         )(y, decode_pos)
-        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.fused_tails and self.moe_experts == 0:
+            from ..ops.fused_elementwise import FusedResidualLayerNorm
+
+            # one kernel emits BOTH the new residual stream and its LN —
+            # ln1 has no preceding add (its input IS the stream) and the
+            # final x+mlp add feeds the next block's ln1 across the block
+            # boundary (out of scope for a per-block module), so add+ln2
+            # is the fusable pair
+            x, y = FusedResidualLayerNorm(dtype=self.dtype, name="ln2")(x, attn_out)
+        else:
+            x = x + attn_out
+            y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.moe_experts > 0:
             from ..ops.moe import MoEMLP
 
@@ -103,7 +122,8 @@ class DecoderBlock(nn.Module):
                 name="moe",
             )(y)
         return x + MLP(
-            hidden=int(dim * self.mlp_ratio), out=dim, dtype=self.dtype, name="mlp"
+            hidden=int(dim * self.mlp_ratio), out=dim, dtype=self.dtype,
+            fused_tails=self.fused_tails, name="mlp",
         )(y)
 
 
@@ -141,6 +161,11 @@ class TransformerLM(nn.Module):
     # the O(S^2) einsum the partitioner would otherwise get.  Static
     # config only — parameter shapes/values are unchanged.
     flash_mesh: Optional[Any] = None
+    # Fuse the per-block elementwise tails (residual-add+ln2, fc1
+    # bias+gelu) into single Pallas kernels — config ``model.fused_tails``
+    # (or ``BENCH_LM_FUSED_TAILS=1`` on the bench).  Checkpoint-compatible
+    # both ways; A/B'd in PERF.md round 6.
+    fused_tails: bool = False
     # KV-cache incremental decode (serving): ``model.clone(decode=True)``
     # gives the serving-side module — same params, plus a "cache" variable
     # collection of capacity ``max_len`` per block.  ``__call__`` with
@@ -227,6 +252,7 @@ class TransformerLM(nn.Module):
                 ),
                 decode=self.decode,
                 cache_len=self.max_len if self.decode else 0,
+                fused_tails=self.fused_tails,
                 name=f"block{i}",
             )(x, decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)

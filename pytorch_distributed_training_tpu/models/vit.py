@@ -32,9 +32,19 @@ class MLP(nn.Module):
     hidden: int
     out: int
     dtype: Any = jnp.float32
+    # fuse the fc1 bias-add + GELU tail into one Pallas kernel
+    # (ops/fused_elementwise.py); parameter tree is identical either way,
+    # so the flag is checkpoint-compatible.  Off by default — only the LM
+    # bench path turns it on (model.fused_tails).
+    fused_tails: bool = False
 
     @nn.compact
     def __call__(self, x):
+        if self.fused_tails:
+            from ..ops.fused_elementwise import FusedDenseGelu
+
+            x = FusedDenseGelu(hidden=self.hidden, dtype=self.dtype, name="fc1")(x)
+            return nn.Dense(self.out, dtype=self.dtype, name="fc2")(x)
         x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
         # exact (erf) GELU: torchvision's VisionTransformer convention —
         # flax's tanh-approximate default costs ~2e-4 logit drift vs ported

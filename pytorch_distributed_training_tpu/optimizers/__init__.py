@@ -26,6 +26,7 @@ is evaluated on-device from the step counter (see ``schedulers``).
 """
 from __future__ import annotations
 
+import itertools
 from typing import Any, Dict, NamedTuple
 
 import jax
@@ -65,6 +66,7 @@ class _Out(NamedTuple):
     param: Any
     aux1: Any
     aux2: Any = None
+    aux3: Any = None
 
 
 def _unzip(tree_of_out, n: int):
@@ -75,8 +77,70 @@ def _unzip(tree_of_out, n: int):
     )
 
 
+def _fused_map(fn, n_out: int, *trees):
+    """``jax.tree.map(fn, *trees)`` + unzip, as ONE kernel per dtype group.
+
+    The per-leaf map hands XLA one fusion root per parameter leaf, so the
+    optimizer tail of a deep model pays one (tiny, launch-bound) kernel per
+    leaf — ~300 launches for the flagship LM.  Here every leaf is raveled
+    and concatenated into a single flat buffer per dtype signature, ``fn``
+    runs ONCE over each buffer, and the results are split/reshaped back.
+
+    ``fn`` must be elementwise over its array arguments (scalars broadcast
+    fine): concatenation then commutes with the math, so the result is
+    BITWISE identical to the per-leaf path (regression-tested in
+    tests/test_profiling.py).  Reductions per leaf (e.g. LARS trust norms)
+    would NOT commute — LARS therefore has no fused mode.
+
+    Leaves are grouped by the dtype tuple across trees so mixed-precision
+    states (bf16 params + f32 moments, or vice versa) never get silently
+    cast by a shared buffer.
+    """
+    treedef = jax.tree.structure(trees[0])
+    leaves_per_tree = [treedef.flatten_up_to(t) for t in trees]
+    n_leaf = len(leaves_per_tree[0])
+    if n_leaf == 0:
+        out = jax.tree.map(fn, *trees)
+        return _unzip(out, n_out)
+    groups: Dict[Any, list] = {}
+    for i in range(n_leaf):
+        key = tuple(jnp.result_type(t[i]) for t in leaves_per_tree)
+        groups.setdefault(key, []).append(i)
+    out_leaves = [[None] * n_leaf for _ in range(n_out)]
+    for idxs in groups.values():
+        flats = [
+            (
+                jnp.concatenate([t[i].reshape(-1) for i in idxs])
+                if len(idxs) > 1
+                else t[idxs[0]].reshape(-1)
+            )
+            for t in leaves_per_tree
+        ]
+        res = fn(*flats)
+        sizes = [leaves_per_tree[0][i].size for i in idxs]
+        offsets = list(itertools.accumulate(sizes[:-1]))  # static split points
+        for j in range(n_out):
+            buf = res[j]
+            parts = jnp.split(buf, offsets) if offsets else [buf]
+            for i, part in zip(idxs, parts):
+                out_leaves[j][i] = part.reshape(leaves_per_tree[0][i].shape)
+    return tuple(jax.tree.unflatten(treedef, out_leaves[j]) for j in range(n_out))
+
+
+def _apply_map(fused: bool, fn, n_out: int, *trees):
+    """Route a per-leaf elementwise update through tree.map or ``_fused_map``."""
+    if fused:
+        return _fused_map(fn, n_out, *trees)
+    return _unzip(jax.tree.map(fn, *trees), n_out)
+
+
 class SGD:
-    """``torch.optim.SGD``-semantics SGD (see module docstring)."""
+    """``torch.optim.SGD``-semantics SGD (see module docstring).
+
+    ``fused=True`` routes the (elementwise) update through ``_fused_map``:
+    one kernel per dtype group instead of one per parameter leaf, bitwise
+    identical results.  Config surface: ``training.optimizer.fused: true``.
+    """
 
     def __init__(
         self,
@@ -85,6 +149,7 @@ class SGD:
         weight_decay: float = 0.0,
         dampening: float = 0.0,
         nesterov: bool = False,
+        fused: bool = False,
     ):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError("Nesterov momentum requires momentum > 0 and dampening = 0")
@@ -93,6 +158,7 @@ class SGD:
         self.weight_decay = float(weight_decay)
         self.dampening = float(dampening)
         self.nesterov = bool(nesterov)
+        self.fused = bool(fused)
 
     def init(self, params) -> SGDState:
         return SGDState(
@@ -100,11 +166,8 @@ class SGD:
             step=jnp.zeros((), dtype=jnp.int32),
         )
 
-    def update(self, grads, state: SGDState, params, lr=None):
-        if lr is None:
-            lr = self.lr
+    def _one(self, lr, first):
         mu, wd, damp = self.momentum, self.weight_decay, self.dampening
-        first = state.step == 0
 
         def one(g, p, buf):
             d = g + wd * p if wd != 0 else g
@@ -117,9 +180,36 @@ class SGD:
                 step_dir = d
             return _Out(p - lr * step_dir, new_buf)
 
-        flat = jax.tree.map(one, grads, params, state.momentum)
-        new_params, new_bufs = _unzip(flat, 2)
+        return one
+
+    def update(self, grads, state: SGDState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        one = self._one(lr, state.step == 0)
+        new_params, new_bufs = _apply_map(
+            self.fused, one, 2, grads, params, state.momentum
+        )
         return new_params, SGDState(momentum=new_bufs, step=state.step + 1)
+
+    def update_with_ema(self, grads, state: SGDState, params, lr, ema, decay):
+        """Parameter update + EMA fold in the same fused pass.
+
+        ``new_ema = decay * ema + (1 - decay) * new_param`` — identical math
+        to the post-hoc tree.map in engine/steps.py, but emitted inside the
+        same kernel(s) as the update so the EMA stops paying its own
+        one-kernel-per-leaf tail.
+        """
+        one = self._one(lr, state.step == 0)
+        d = decay
+
+        def one_ema(g, p, buf, e):
+            out = one(g, p, buf)
+            return _Out(out.param, out.aux1, d * e + (1.0 - d) * out.param)
+
+        new_params, new_bufs, new_ema = _apply_map(
+            self.fused, one_ema, 3, grads, params, state.momentum, ema
+        )
+        return new_params, SGDState(momentum=new_bufs, step=state.step + 1), new_ema
 
 
 def _is_excluded(param) -> bool:
@@ -211,11 +301,13 @@ class AdamW:
         betas=(0.9, 0.999),
         eps: float = 1e-8,
         weight_decay: float = 1e-2,
+        fused: bool = False,
     ):
         self.lr = float(lr)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
+        self.fused = bool(fused)
 
     def init(self, params) -> AdamWState:
         return AdamWState(
@@ -224,11 +316,9 @@ class AdamW:
             step=jnp.zeros((), dtype=jnp.int32),
         )
 
-    def update(self, grads, state: AdamWState, params, lr=None):
-        if lr is None:
-            lr = self.lr
+    def _one(self, lr, step):
         b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
-        t = (state.step + 1).astype(jnp.float32)
+        t = (step + 1).astype(jnp.float32)
         bc1 = 1.0 - b1**t
         bc2 = 1.0 - b2**t
 
@@ -239,9 +329,34 @@ class AdamW:
             denom = jnp.sqrt(new_nu) / jnp.sqrt(bc2) + eps
             return _Out(p - (lr / bc1) * new_mu / denom, new_mu, new_nu)
 
-        flat = jax.tree.map(one, grads, params, state.mu, state.nu)
-        new_params, new_mu, new_nu = _unzip(flat, 3)
+        return one
+
+    def update(self, grads, state: AdamWState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        one = self._one(lr, state.step)
+        new_params, new_mu, new_nu = _apply_map(
+            self.fused, one, 3, grads, params, state.mu, state.nu
+        )
         return new_params, AdamWState(mu=new_mu, nu=new_nu, step=state.step + 1)
+
+    def update_with_ema(self, grads, state: AdamWState, params, lr, ema, decay):
+        """Parameter update + EMA fold in one pass (see ``SGD.update_with_ema``)."""
+        one = self._one(lr, state.step)
+        d = decay
+
+        def one_ema(g, p, mu, nu, e):
+            out = one(g, p, mu, nu)
+            return _Out(out.param, out.aux1, out.aux2, d * e + (1.0 - d) * out.param)
+
+        new_params, new_mu, new_nu, new_ema = _apply_map(
+            self.fused, one_ema, 4, grads, params, state.mu, state.nu, ema
+        )
+        return (
+            new_params,
+            AdamWState(mu=new_mu, nu=new_nu, step=state.step + 1),
+            new_ema,
+        )
 
 
 OPTIMIZERS = {

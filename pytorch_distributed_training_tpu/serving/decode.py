@@ -1,14 +1,23 @@
 """Autoregressive generation over the TransformerLM KV-cache decode mode.
 
-One jit program per (batch bucket, sequence bucket): prefill the prompt
-batch in a single pass, then a ``lax.while_loop`` of single-token steps.
-The whole batch shares the program, but every row carries its own
-``prompt_len`` — prompts are right-padded to the bucket's sequence length
-and the per-row cache positions (ops/attention.py) keep padded rows exact.
+One jit program pair per (batch bucket, sequence bucket): ``prefill``
+consumes the prompt batch in a single pass (filling the KV cache and
+sampling the first token), ``decode`` runs a ``lax.while_loop`` of
+single-token steps.  The whole batch shares the programs, but every row
+carries its own ``prompt_len`` — prompts are right-padded to the bucket's
+sequence length and the per-row cache positions (ops/attention.py) keep
+padded rows exact.
 
 ``while_loop`` rather than ``scan`` so a batch whose rows all hit EOS
 stops paying decode steps (the EOS early-exit of the ISSUE): the carry is
 scan-shaped, the trip count is data-dependent.
+
+The two phases are separate XLA programs (round 6) so the engine can time
+them independently — prefill is compute-bound (one big batched forward),
+decode is latency-bound (max_new_tokens tiny steps); one fused program
+hides which side a serving regression lives on.  ``build_generate_fn``
+returns a callable object: ``__call__`` chains the phases (the original
+contract), ``.prefill`` / ``.decode`` expose them for phase-timed serving.
 """
 from __future__ import annotations
 
@@ -18,6 +27,30 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["build_generate_fn"]
+
+
+class _GenerateFn:
+    """``prefill`` + ``decode`` jit pair with the fused-call contract.
+
+    ``prefill(params, tokens, prompt_len, rng) -> carry`` — fills the KV
+    cache from the padded prompts and samples generated token 0.
+    ``decode(params, prompt_len, carry) -> (out_tokens, gen_len)`` — the
+    EOS-early-exit while_loop over single-token steps.
+    ``__call__`` chains them, matching the pre-split ``generate`` contract.
+    """
+
+    def __init__(self, prefill, decode):
+        self.prefill = prefill
+        self.decode = decode
+
+    def __call__(self, params, tokens, prompt_len, rng):
+        carry = self.prefill(params, tokens, prompt_len, rng)
+        return self.decode(params, prompt_len, carry)
+
+    def _cache_size(self) -> int:
+        """Total distinct XLA programs compiled (both phases) — feeds the
+        engine's ``compile_count`` bucket-grid bound."""
+        return self.prefill._cache_size() + self.decode._cache_size()
 
 
 def build_generate_fn(
@@ -31,11 +64,12 @@ def build_generate_fn(
     ``model``: a :class:`..models.transformer_lm.TransformerLM` (decode
     flag irrelevant — it is cloned with ``decode=True`` here).
 
-    Returns a jitted function mapping ``tokens`` [B, S] int32 (prompts
-    right-padded to S) and ``prompt_len`` [B] int32 (1 <= len <= S) to
-    ``(out_tokens [B, max_new_tokens] int32, gen_len [B] int32)`` where
-    ``gen_len`` counts valid generated tokens per row (including the EOS
-    token when one was produced); positions past ``gen_len`` are 0.
+    Returns a :class:`_GenerateFn` whose ``__call__`` maps ``tokens``
+    [B, S] int32 (prompts right-padded to S) and ``prompt_len`` [B] int32
+    (1 <= len <= S) to ``(out_tokens [B, max_new_tokens] int32,
+    gen_len [B] int32)`` where ``gen_len`` counts valid generated tokens
+    per row (including the EOS token when one was produced); positions
+    past ``gen_len`` are 0.
 
     ``temperature == 0.0`` (static) is greedy argmax and ignores ``rng``;
     otherwise tokens are drawn from ``softmax(logits / temperature)``.
@@ -58,7 +92,7 @@ def build_generate_fn(
         return tok == eos_id
 
     @jax.jit
-    def generate(params, tokens, prompt_len, rng):
+    def prefill(params, tokens, prompt_len, rng):
         b, s = tokens.shape
         if s + max_new_tokens > max_len:
             # the last generated token's position is prompt_len-1+max_new
@@ -82,13 +116,18 @@ def build_generate_fn(
         done = hit_eos(tok)
         out = jnp.zeros((b, max_new_tokens), jnp.int32).at[:, 0].set(tok)
         gen_len = jnp.ones((b,), jnp.int32)
+        return cache, tok, out, done, gen_len, rng
 
-        def cond(carry):
-            i, _, _, _, done, _, _ = carry
+    @jax.jit
+    def decode(params, prompt_len, carry):
+        cache0, tok0, out0, done0, gen_len0, rng0 = carry
+
+        def cond(c):
+            i, _, _, _, done, _, _ = c
             return (i < max_new_tokens) & ~done.all()
 
-        def body(carry):
-            i, cache, prev, out, done, gen_len, rng = carry
+        def body(c):
+            i, cache, prev, out, done, gen_len, rng = c
             # prev = generated token i-1, which sits at sequence position
             # prompt_len + i - 1; feeding it yields the logits for token i
             pos = prompt_len + i - 1
@@ -106,8 +145,8 @@ def build_generate_fn(
             done = done | hit_eos(tok) | (pos + 1 >= max_len)
             return (i + 1, cache, tok, out, done, gen_len, rng)
 
-        carry = (jnp.int32(1), cache, tok, out, done, gen_len, rng)
-        _, _, _, out, _, gen_len, _ = jax.lax.while_loop(cond, body, carry)
+        full = (jnp.int32(1), cache0, tok0, out0, done0, gen_len0, rng0)
+        _, _, _, out, _, gen_len, _ = jax.lax.while_loop(cond, body, full)
         return out, gen_len
 
-    return generate
+    return _GenerateFn(prefill, decode)

@@ -246,17 +246,22 @@ class InferenceEngine:
     def _run_batch(self, requests: List[Request]) -> List[Any]:
         depth = self.batcher.depth()
         if self.is_lm:
-            results = self._run_lm(requests)
+            results, phase = self._run_lm(requests)
             n_items = int(sum(r["gen_len"] for r in results))
+            self.metrics.record_batch(
+                [r.enqueued_at for r in requests], n_items, depth,
+                gen_lens=[r["gen_len"] for r in results], **phase,
+            )
         else:
             results = self._run_images(requests)
-            n_items = len(results)
-        self.metrics.record_batch(
-            [r.enqueued_at for r in requests], n_items, depth
-        )
+            self.metrics.record_batch(
+                [r.enqueued_at for r in requests], len(results), depth
+            )
         return results
 
-    def _run_lm(self, requests: List[Request]) -> List[Any]:
+    def _run_lm(self, requests: List[Request]):
+        import time
+
         lens = [req.payload.size for req in requests]
         bb = self._bucket_for(len(requests), self.batch_buckets, "batch size")
         sb = self._bucket_for(max(lens), self.seq_buckets, "prompt length")
@@ -267,18 +272,30 @@ class InferenceEngine:
             prompt_len[i] = lens[i]
         tok_sh = batch_sharding(self.mesh, 2)
         row_sh = batch_sharding(self.mesh, 1)
-        out, gen_len = self._generate(
-            self.params,
-            jax.device_put(tokens, tok_sh),
-            jax.device_put(prompt_len, row_sh),
+        plen_dev = jax.device_put(prompt_len, row_sh)
+        # phase-timed (round 6): prefill and decode are separate programs
+        # (serving/decode.py), so each gets its own wall clock — the sync
+        # between them is one block_until_ready on the carry, which the
+        # decode dispatch would have waited on anyway
+        t0 = time.perf_counter()
+        carry = self._generate.prefill(
+            self.params, jax.device_put(tokens, tok_sh), plen_dev,
             self._next_rng(),
         )
-        out = np.asarray(out)
+        jax.block_until_ready(carry)
+        t1 = time.perf_counter()
+        out, gen_len = self._generate.decode(self.params, plen_dev, carry)
+        out = np.asarray(out)  # host materialization = decode sync
         gen_len = np.asarray(gen_len)
-        return [
+        t2 = time.perf_counter()
+        results = [
             {"tokens": out[i, : gen_len[i]], "gen_len": int(gen_len[i])}
             for i in range(len(requests))
         ]
+        phase = dict(
+            prompt_tokens=int(sum(lens)), prefill_s=t1 - t0, decode_s=t2 - t1
+        )
+        return results, phase
 
     def _run_images(self, requests: List[Request]) -> List[Any]:
         bb = self._bucket_for(len(requests), self.batch_buckets, "batch size")

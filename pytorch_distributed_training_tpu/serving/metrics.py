@@ -27,11 +27,31 @@ class ServingMetrics:
         self._first_t: Optional[float] = None
         self._last_t: Optional[float] = None
         self._max_depth = 0
+        # LM phase split (round 6): per-request generated-token counts plus
+        # accumulated prefill/decode device seconds and prompt tokens, so
+        # the snapshot can report prefill vs decode tokens/s separately
+        self._gen_lens: List[int] = []
+        self._prompt_tokens = 0
+        self._prefill_s = 0.0
+        self._decode_s = 0.0
 
     def record_batch(
-        self, enqueued_ats: List[float], n_items: int, queue_depth: int = 0
+        self,
+        enqueued_ats: List[float],
+        n_items: int,
+        queue_depth: int = 0,
+        gen_lens: Optional[List[int]] = None,
+        prompt_tokens: int = 0,
+        prefill_s: float = 0.0,
+        decode_s: float = 0.0,
     ) -> None:
-        """One flushed batch: per-request enqueue stamps + work-item count."""
+        """One flushed batch: per-request enqueue stamps + work-item count.
+
+        LM batches additionally pass ``gen_lens`` (generated tokens per
+        request), ``prompt_tokens`` (REAL prompt tokens consumed, not the
+        padded bucket area), and the measured ``prefill_s`` / ``decode_s``
+        phase wall times.
+        """
         now = time.monotonic()
         with self._lock:
             for t0 in enqueued_ats:
@@ -42,6 +62,11 @@ class ServingMetrics:
                 self._first_t = now
             self._last_t = now
             self._max_depth = max(self._max_depth, queue_depth)
+            if gen_lens is not None:
+                self._gen_lens.extend(int(g) for g in gen_lens)
+            self._prompt_tokens += int(prompt_tokens)
+            self._prefill_s += float(prefill_s)
+            self._decode_s += float(decode_s)
 
     def observe_depth(self, depth: int) -> None:
         with self._lock:
@@ -59,6 +84,10 @@ class ServingMetrics:
             )
             items = self._items
             depth = self._max_depth
+            gen = np.asarray(self._gen_lens, np.float64)
+            prompt_tokens = self._prompt_tokens
+            prefill_s = self._prefill_s
+            decode_s = self._decode_s
         out = {
             "requests": int(lat.size),
             "batches": int(sizes.size),
@@ -75,6 +104,18 @@ class ServingMetrics:
         # so fall back to unreported rather than divide-by-zero noise
         if span > 0:
             out["items_per_sec"] = float(items / span)
+        if gen.size:
+            out["gen_tokens"] = int(gen.sum())
+            out["gen_len_mean"] = float(gen.mean())
+            out["gen_len_p50"] = float(np.percentile(gen, 50))
+            # phase rates: prefill consumes real prompt tokens, decode emits
+            # generated tokens (token 0 is sampled by the prefill program —
+            # one token per request of attribution noise, documented rather
+            # than corrected)
+            if prefill_s > 0:
+                out["prefill_tokens_per_sec"] = float(prompt_tokens / prefill_s)
+            if decode_s > 0:
+                out["decode_tokens_per_sec"] = float(gen.sum() / decode_s)
         return out
 
     def log_summary(self, logger, prefix: str = "serving") -> Dict[str, float]:

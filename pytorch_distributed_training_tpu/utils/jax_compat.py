@@ -1,0 +1,69 @@
+"""Opt-in graft of newer-JAX surface onto an older install, in-repo only.
+
+The codebase targets the toolchain's grafted JAX API: ``jax.shard_map``
+as a top-level export with a ``check_vma=`` kwarg.  On a vanilla
+jax<=0.4.x install (the CPU dev image, which lacks the toolchain graft)
+that name never left ``jax.experimental.shard_map`` and the kwarg is
+spelled ``check_rep`` — every shard_map-based step dies with
+``AttributeError: module 'jax' has no attribute 'shard_map'`` before a
+single op runs.
+
+``install()`` bridges the gap WITHOUT touching site-packages: when
+``jax.shard_map`` already exists it is a strict no-op; otherwise, IF the
+environment sets ``PDT_JAX_COMPAT=1``, it publishes a thin wrapper
+around the experimental entry point.  Two deliberate design points:
+
+- **Opt-in, not automatic.**  Pre-vma shard_map has DIFFERENT autodiff
+  semantics for in-body collectives: ``grad`` through a body-internal
+  ``pmean/psum`` yields the per-device LOCAL cotangent (old transpose
+  rules), where the vma-typed shard_map yields the replicated mean this
+  codebase's DP/SP steps are written against.  On a multi-device mesh a
+  compat-mode training step therefore computes WRONG gradients — a
+  silently-diverging run is far worse than the loud AttributeError, so
+  the graft never turns itself on.  Single-device meshes are exempt from
+  the caveat (collectives over an axis of size 1 are identity, and the
+  identity's transpose is exact), which is what makes compat mode useful
+  at all: single-device CPU smoke runs of the real step/bench code are
+  numerically trustworthy end to end.
+- **An alias, not a vendored implementation.**  On the real toolchain
+  the grafted ``jax.shard_map`` wins untouched, so chip behavior can
+  never diverge from what the driver benches.  ``check_vma`` is dropped
+  and ``check_rep`` forced off because the old static replication
+  checker rejects valid programs the vma type system accepts (e.g. the
+  DP train step's pmean'd gradients).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+__all__ = ["install"]
+
+
+def install() -> None:
+    """Publish ``jax.shard_map`` when missing and ``PDT_JAX_COMPAT=1``."""
+    if hasattr(jax, "shard_map"):  # grafted/new JAX: nothing to do
+        return
+    if os.environ.get("PDT_JAX_COMPAT") != "1":
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _exp_shard_map
+    except ImportError:  # pragma: no cover - no known JAX hits this
+        return
+
+    @functools.wraps(_exp_shard_map)
+    def shard_map(*args, **kwargs):
+        kwargs.pop("check_vma", None)
+        kwargs["check_rep"] = False
+        # new API spells "manual over these axes, auto over the rest" as
+        # axis_names={...}; the experimental entry point spells the same
+        # thing as the complement, auto={rest of the mesh axes}
+        axis_names = kwargs.pop("axis_names", None)
+        if axis_names is not None:
+            mesh = kwargs.get("mesh", args[1] if len(args) > 1 else None)
+            kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _exp_shard_map(*args, **kwargs)
+
+    jax.shard_map = shard_map

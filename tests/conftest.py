@@ -26,6 +26,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Opt-in graft of jax.shard_map for pre-graft JAX installs (no-op on the
+# real toolchain, and inert unless PDT_JAX_COMPAT=1 — see the autodiff
+# caveat in utils/jax_compat.py before enabling it for multi-device runs).
+from pytorch_distributed_training_tpu.utils import jax_compat  # noqa: E402
+
+jax_compat.install()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

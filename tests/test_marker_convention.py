@@ -1,0 +1,36 @@
+"""Marker-convention guard: bench-driving tests must be ``slow``-marked.
+
+The driver's tier-1 gate runs ``pytest -m 'not slow'`` inside a 870s
+budget (ROADMAP.md).  Any test that shells out to ``bench.py`` pays a
+full model compile + timed windows in a subprocess — minutes, not
+seconds — so it must carry ``@pytest.mark.slow`` or it silently eats the
+tier-1 budget.  A static AST scan (collection-speed, no imports) rather
+than a runtime fixture: the convention must hold even for tests that
+would be skipped on this platform.
+"""
+import ast
+import pathlib
+
+
+def test_bench_driving_tests_are_slow_marked():
+    here = pathlib.Path(__file__).parent
+    offenders = []
+    for path in sorted(here.glob("test_*.py")):
+        if path.name == "test_marker_convention.py":
+            continue  # this guard names bench.py without driving it
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            body_src = ast.unparse(node)
+            if "bench.py" not in body_src:
+                continue
+            decorators = [ast.unparse(d) for d in node.decorator_list]
+            if not any("slow" in d for d in decorators):
+                offenders.append(f"{path.name}::{node.name}")
+    assert not offenders, (
+        "tests driving bench.py must be @pytest.mark.slow (tier-1 runs "
+        f"-m 'not slow' in a fixed budget): {offenders}"
+    )

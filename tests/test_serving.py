@@ -270,8 +270,10 @@ def test_engine_compile_count_bounded_by_buckets(lm_engine):
     for res in results:
         assert 1 <= res["gen_len"] <= 4
         assert res["tokens"].shape == (res["gen_len"],)
-    # 1 batch bucket x 2 seq buckets => at most 2 XLA programs ever
-    assert lm_engine.compile_count() <= 2
+    # 1 batch bucket x 2 seq buckets, 2 programs per cell (prefill +
+    # decode are separate jits since the round-6 phase split) => at most
+    # 4 XLA programs ever
+    assert lm_engine.compile_count() <= 4
 
 
 def test_engine_rejects_oversized_prompt(lm_engine):
@@ -353,6 +355,33 @@ def test_metrics_snapshot_percentiles():
     assert snap["latency_ms_p99"] <= 105.0  # largest recorded ~100ms
 
 
+def test_metrics_phase_split_and_gen_lens():
+    """Round 6: per-request generated-token counts + prefill/decode rates."""
+    from pytorch_distributed_training_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    now = time.monotonic()
+    m.record_batch(
+        [now, now], n_items=7, gen_lens=[3, 4], prompt_tokens=20,
+        prefill_s=0.01, decode_s=0.07,
+    )
+    m.record_batch(
+        [now], n_items=2, gen_lens=[2], prompt_tokens=5,
+        prefill_s=0.01, decode_s=0.01,
+    )
+    snap = m.snapshot()
+    assert snap["gen_tokens"] == 9
+    assert snap["gen_len_mean"] == pytest.approx(3.0)
+    assert snap["gen_len_p50"] == pytest.approx(3.0)
+    assert snap["prefill_tokens_per_sec"] == pytest.approx(25 / 0.02)
+    assert snap["decode_tokens_per_sec"] == pytest.approx(9 / 0.08)
+    # image-path batches (no gen_lens) must not emit the LM-only fields
+    m2 = ServingMetrics()
+    m2.record_batch([now], n_items=4)
+    assert "gen_tokens" not in m2.snapshot()
+    assert "prefill_tokens_per_sec" not in m2.snapshot()
+
+
 def test_serving_cli_smoke(tmp_path, capsys):
     """The acceptance-criteria round trip, in-process (fast: tiny model)."""
     import json
@@ -379,5 +408,6 @@ serving:
     tail = capsys.readouterr().out.strip().splitlines()[-1]
     snap = json.loads(tail)["serving"]
     assert snap["requests"] == 8
-    assert snap["compile_count"] <= 2
+    # 2 per exercised bucket cell since the prefill/decode phase split
+    assert snap["compile_count"] <= 4
     assert snap["latency_ms_p50"] > 0
