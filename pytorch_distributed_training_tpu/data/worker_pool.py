@@ -21,6 +21,23 @@ Design:
     batch cannot change its bytes.
   - A generation counter lets an abandoned epoch iterator drain its
     in-flight results without poisoning the next epoch.
+
+Fault tolerance (worker respawn): each worker owns BOTH of its queues — a
+process SIGKILLed while blocked in ``Queue.get`` dies holding the queue's
+shared reader lock, and one killed while its feeder thread holds the
+*result* queue's write lock wedges every other writer, so any queue a dead
+worker ever touched is unrecoverable and must be abandoned wholesale
+(single-owner queues make that safe; a shared result queue would poison
+the survivors).  The pool keeps its own ledger of what each worker owes
+(``_inflight``: submitted minus collected), so when ``_collect_one``'s
+poll times out and an exitcode check finds a dead worker, the pool
+replaces both its queues, resubmits every batch the worker still owed,
+and respawns it with the same shard (queue) assignment — the epoch
+continues without dropping or duplicating a batch.  Results the dying
+worker managed to flush are either collected before the poll can time out
+(popped from the ledger, never resubmitted) or discarded along with its
+result queue and re-executed from the ledger — identical bytes either
+way, since batch content is deterministic per (seed, epoch, index).
 """
 from __future__ import annotations
 
@@ -93,9 +110,13 @@ class ProcessLoaderPool:
         num_workers: int,
         seed: int,
         n_slots: Optional[int] = None,
+        max_respawns: int = 8,
+        stall_timeout: float = 60.0,
     ):
         if num_workers < 1:
             raise ValueError("ProcessLoaderPool requires num_workers >= 1")
+        if stall_timeout <= 0:
+            raise ValueError(f"stall_timeout must be > 0, got {stall_timeout}")
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.sample_shape = tuple(int(s) for s in sample_shape)
@@ -112,6 +133,13 @@ class ProcessLoaderPool:
         # in a generator finally that may not have run yet
         self._outstanding = 0
         self._closed = False
+        # (gen, seq) -> (wid, task): every task submitted and not yet
+        # collected, in submission order — the respawn ledger
+        self._inflight = {}
+        self.max_respawns = int(max_respawns)
+        self.respawns = 0
+        self._poll_seconds = 1.0
+        self._stall_timeout = float(stall_timeout)
 
         slot_bytes = (
             self.batch_size * int(np.prod(self.sample_shape)) * self.sample_dtype.itemsize
@@ -131,31 +159,31 @@ class ProcessLoaderPool:
             (self.n_slots, self.batch_size), dtype=np.int64, buffer=self._lshm.buf
         )
 
-        ctx = mp.get_context("spawn")
-        self._task_q = ctx.Queue()
-        self._result_q = ctx.Queue()
-        self._procs = [
-            ctx.Process(
-                target=_pool_worker_main,
-                args=(
-                    dataset,
-                    self.seed,
-                    self._shm.name,
-                    self._lshm.name,
-                    self.n_slots,
-                    self.batch_size,
-                    self.sample_shape,
-                    self.sample_dtype.str,
-                    self._task_q,
-                    self._result_q,
-                ),
-                daemon=True,
-            )
-            for _ in range(self.num_workers)
-        ]
-        for p in self._procs:
-            p.start()
+        self._ctx = mp.get_context("spawn")
+        self._task_qs = [self._ctx.Queue() for _ in range(self.num_workers)]
+        self._result_qs = [self._ctx.Queue() for _ in range(self.num_workers)]
+        self._procs = [self._spawn_worker(i) for i in range(self.num_workers)]
         atexit.register(self.close)
+
+    def _spawn_worker(self, wid: int):
+        p = self._ctx.Process(
+            target=_pool_worker_main,
+            args=(
+                self.dataset,
+                self.seed,
+                self._shm.name,
+                self._lshm.name,
+                self.n_slots,
+                self.batch_size,
+                self.sample_shape,
+                self.sample_dtype.str,
+                self._task_qs[wid],
+                self._result_qs[wid],
+            ),
+            daemon=True,
+        )
+        p.start()
+        return p
 
     # ------------------------------------------------------------------ epoch
     def run_epoch(
@@ -185,7 +213,15 @@ class ProcessLoaderPool:
             while free and pending:
                 seq, idxs = pending.popleft()
                 slot = free.pop()
-                self._task_q.put((gen, seq, slot, int(epoch), np.asarray(idxs)))
+                # fixed shard assignment: batch seq always goes to worker
+                # seq % num_workers, and a respawned worker inherits its
+                # predecessor's queue position — so which process decodes a
+                # batch is deterministic across kills (batch bytes already
+                # are, via per-sample augmentation streams)
+                wid = seq % self.num_workers
+                task = (gen, seq, slot, int(epoch), np.asarray(idxs))
+                self._inflight[(gen, seq)] = (wid, task)
+                self._task_qs[wid].put(task)
                 self._outstanding += 1
             if next_yield in done:
                 slot = done.pop(next_yield)
@@ -203,17 +239,70 @@ class ProcessLoaderPool:
             done[seq] = slot
 
     def _collect_one(self):
+        waited = 0.0
+        per_q = self._poll_seconds / self.num_workers
         while True:
-            try:
-                r = self._result_q.get(timeout=5.0)
-                self._outstanding -= 1
-                return r
-            except queue.Empty:
-                dead = [p.pid for p in self._procs if not p.is_alive()]
-                if dead:
+            r = None
+            for result_q in self._result_qs:
+                try:
+                    r = result_q.get(timeout=per_q)
+                    break
+                except queue.Empty:
+                    continue
+            if r is None:
+                waited += self._poll_seconds
+                if self._reap_and_respawn():
+                    waited = 0.0
+                elif waited >= self._stall_timeout:
                     raise RuntimeError(
-                        f"decode worker process(es) died: pids {dead}"
+                        f"loader pool stalled: no result for {waited:.0f}s "
+                        f"with {self._outstanding} task(s) outstanding and "
+                        f"all {self.num_workers} worker(s) alive"
                     ) from None
+                continue
+            self._outstanding -= 1
+            self._inflight.pop((r[0], r[1]), None)
+            return r
+
+    def _reap_and_respawn(self) -> bool:
+        """Respawn dead workers, resubmitting every task they still owed.
+
+        Called only after a full result poll cycle came up Empty, so any
+        result a dying worker managed to flush has normally been collected
+        already (ledger entry popped); whatever remains under the dead
+        worker's id is re-executed.  Both of the worker's queues are
+        abandoned — the corpse may hold the task queue's reader lock or
+        the result queue's writer lock, either of which would wedge a
+        reusing successor — and a flushed-but-uncollected result discarded
+        with the old result queue is simply re-executed from the ledger
+        (same bytes: batch content is deterministic per (seed, epoch,
+        index)).  Returns True when a worker was respawned.
+        """
+        respawned = False
+        for wid, p in enumerate(self._procs):
+            if p.is_alive():
+                continue
+            if self.respawns >= self.max_respawns:
+                raise RuntimeError(
+                    f"decode worker {wid} (pid {p.pid}) died with exitcode "
+                    f"{p.exitcode} and the respawn budget "
+                    f"({self.max_respawns}) is exhausted"
+                )
+            for old_q in (self._task_qs[wid], self._result_qs[wid]):
+                old_q.cancel_join_thread()
+                old_q.close()
+            self._task_qs[wid] = self._ctx.Queue()
+            self._result_qs[wid] = self._ctx.Queue()
+            for owner, task in self._inflight.values():
+                if owner == wid:
+                    self._task_qs[wid].put(task)
+            self.respawns += 1
+            self._procs[wid] = self._spawn_worker(wid)
+            respawned = True
+            from ..engine import fault
+
+            fault.bump("worker_respawns")
+        return respawned
 
     # ------------------------------------------------------------------ close
     def close(self) -> None:
@@ -221,13 +310,28 @@ class ProcessLoaderPool:
             return
         self._closed = True
         try:
-            for _ in self._procs:
-                self._task_q.put(None)
+            for q in self._task_qs:
+                try:
+                    q.put(None)
+                except Exception:  # pragma: no cover - queue already broken
+                    pass
             for p in self._procs:
                 p.join(timeout=2.0)
+            # escalate: a wedged worker (stuck decode, poisoned lock) must
+            # not hang interpreter shutdown — terminate, then SIGKILL
             for p in self._procs:
                 if p.is_alive():
                     p.terminate()
+            for p in self._procs:
+                if p.is_alive():
+                    p.join(timeout=1.0)
+            for p in self._procs:
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=1.0)
+            for q in self._task_qs + self._result_qs:
+                q.cancel_join_thread()
+                q.close()
         finally:
             for shm in (self._shm, self._lshm):
                 try:

@@ -72,13 +72,29 @@ def _orbax_metadata_contract_ok(logger: Optional[logging.Logger] = None) -> bool
 
 
 class Checkpointer:
-    """Thin orbax CheckpointManager wrapper keyed by iteration."""
+    """Thin orbax CheckpointManager wrapper keyed by iteration.
 
-    def __init__(self, directory: str, interval: int = 1000, max_to_keep: int = 3):
+    Fault tolerance (additive, ``training.checkpoint.retry``): save and
+    restore attempts run under a :class:`..utils.retry.Retry` policy —
+    transient storage errors (``OSError`` family) back off and retry
+    instead of killing the run.  On restore, a checkpoint that stays
+    unreadable after retries is *skipped with a warning* and the newest
+    earlier step is tried (``restore_latest``'s fallback loop), so one
+    corrupt/truncated step directory cannot strand a resumable run.
+    """
+
+    def __init__(self, directory: str, interval: int = 1000, max_to_keep: int = 3,
+                 retry: Optional["Retry"] = None):
         import orbax.checkpoint as ocp
+
+        from ..utils.retry import Retry
 
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self.interval = int(interval)
+        self.retry = retry if retry is not None else Retry(
+            logger=logging.getLogger(__name__)
+        )
+        self.retries = 0  # retried save/restore attempts (observability)
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
@@ -89,41 +105,107 @@ class Checkpointer:
         ck = train_cfg.get("checkpoint")
         if not ck or not ck.get("dir"):
             return None
+        from ..utils.retry import Retry
+
+        rc = ck.get("retry") or {}
+        unknown = set(rc) - {"attempts", "backoff", "max_backoff", "jitter"}
+        if unknown:
+            raise ValueError(
+                f"checkpoint.retry: unknown key(s) {sorted(unknown)} "
+                "(want attempts/backoff/max_backoff/jitter)"
+            )
+        retry = Retry(
+            attempts=int(rc.get("attempts", 3)),
+            backoff=float(rc.get("backoff", 0.25)),
+            max_backoff=float(rc.get("max_backoff", 8.0)),
+            jitter=float(rc.get("jitter", 0.25)),
+            logger=logging.getLogger(__name__),
+        )
         return cls(ck["dir"], interval=ck.get("interval", 1000),
-                   max_to_keep=ck.get("max_to_keep", 3))
+                   max_to_keep=ck.get("max_to_keep", 3), retry=retry)
 
     def latest(self) -> Optional[int]:
         return self._manager.latest_step()
 
+    def all_steps(self) -> list:
+        return sorted(self._manager.all_steps())
+
     def should_save(self, it: int, train_iters: int) -> bool:
         return (it + 1) % self.interval == 0 or it == train_iters - 1
+
+    def _count_retry(self, attempt, exc, delay) -> None:
+        del attempt, exc, delay
+        self.retries += 1
+        from . import fault
+
+        fault.bump("ckpt_retries")
 
     def save(self, it: int, state) -> None:
         import orbax.checkpoint as ocp
 
-        self._manager.save(it, args=ocp.args.StandardSave(state))
+        from . import fault
+
+        def _save():
+            fault.get_injector().check_fail_point("ckpt_save")
+            self._manager.save(it, args=ocp.args.StandardSave(state))
+
+        self.retry.call(_save, on_retry=self._count_retry)
 
     def restore_latest(
         self, state, logger: Optional[logging.Logger] = None
     ) -> Tuple[Any, int]:
-        """Restore the newest checkpoint into ``state``'s structure/shardings.
+        """Restore the newest *readable* checkpoint into ``state``'s
+        structure/shardings.
 
         Returns ``(state, next_iter)``; ``(state, 0)`` when no checkpoint
-        exists yet.
+        exists yet.  A newest step that stays unreadable after retries is
+        skipped with a warning and the next-older step is tried; only when
+        every step fails does the NEWEST step's error re-raise (the most
+        actionable one — it names the checkpoint a resume would want).
         """
+        from . import fault
+
+        steps = self.all_steps()
+        if not steps:
+            return state, 0
+        first_err: Optional[BaseException] = None
+        for step in reversed(steps):
+            try:
+                return self._restore_step(step, state, logger)
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                if step == steps[0]:
+                    break
+                fault.bump("ckpt_fallbacks")
+                (logger or logging.getLogger(__name__)).warning(
+                    "checkpoint step %d at %s is unreadable (%s: %s) — "
+                    "falling back to the previous step",
+                    step, self.directory, type(e).__name__, e,
+                )
+        raise first_err
+
+    def _restore_step(
+        self, step: int, state, logger: Optional[logging.Logger] = None
+    ) -> Tuple[Any, int]:
+        """Restore one specific ``step`` (retry policy + layout conversion)."""
         import orbax.checkpoint as ocp
 
-        step = self._manager.latest_step()
-        if step is None:
-            return state, 0
+        from . import fault
+
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             state,
         )
-        try:
-            restored = self._manager.restore(
+
+        def _restore():
+            fault.get_injector().check_fail_point("ckpt_restore")
+            return self._manager.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
+
+        try:
+            restored = self.retry.call(_restore, on_retry=self._count_retry)
         except Exception as e:
             # A params-layout mismatch (e.g. a checkpoint saved under
             # pipeline_parallelism — stacked {blocks, shared} — restored

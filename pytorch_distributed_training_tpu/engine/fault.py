@@ -1,0 +1,222 @@
+"""Deterministic fault injection + recovery counters (the chaos harness).
+
+Every recovery path in the fault-tolerance layer (anomaly-step guard,
+retrying checkpoint I/O, worker respawn, hung-step watchdog) is proved by
+injecting its failure deterministically and asserting the recovery — not by
+hoping production reproduces it.  The injector is a process-global registry
+parsed from the ``PDT_FAULT_SPEC`` environment variable (or the
+``training.fault_tolerance.fault_spec`` config key; env wins so a chaos
+wrapper can override any config).
+
+Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
+
+    nan_batch@K        poison the training batch fed to step K with NaNs
+                       (float image pipelines; the anomaly guard must skip
+                       the step)
+    kill_worker@K[:W]  SIGKILL loader pool worker W (default 0) at step K
+                       (the pool must respawn it, no batch lost)
+    stall_step@K[:SEC] sleep SEC (default 1.0) inside step K's host window
+                       (the watchdog must fire)
+    ckpt_fail@A[:N]    fail checkpoint-save attempts A..A+N-1 (0-based
+                       attempt ordinal across the process; the retry policy
+                       must absorb them)
+    restore_fail@A[:N] same for checkpoint-restore attempts
+
+Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``) are
+one-shot: consumed when they fire, so a rollback replay of the same step
+index does not re-trip them (the recovery itself must converge).
+
+This module is import-light on purpose (stdlib only): the data pipeline and
+serving stack consult it without pulling the JAX engine in.  Recovery
+counters live here too — one process-global ``Counter`` every subsystem
+bumps (``skipped_steps``, ``rollbacks``, ``ckpt_retries``,
+``worker_respawns``, ``watchdog_fires``, ...) so ``bench.py --chaos`` and
+the fault tests read one ledger.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "ENV_VAR",
+    "FaultInjectionError",
+    "FaultInjector",
+    "get_injector",
+    "install",
+    "bump",
+    "counters",
+    "reset_counters",
+]
+
+ENV_VAR = "PDT_FAULT_SPEC"
+
+_STEP_KINDS = ("nan_batch", "kill_worker", "stall_step")
+_POINT_KINDS = {"ckpt_fail": "ckpt_save", "restore_fail": "ckpt_restore"}
+
+
+class FaultInjectionError(OSError):
+    """An injected I/O failure.
+
+    Subclasses ``OSError`` so it lands in the default retry allowlist
+    (``utils.retry.Retry``) exactly like the transient filesystem errors it
+    stands in for.
+    """
+
+
+class FaultInjector:
+    """Parsed fault spec, queryable by the instrumented call sites."""
+
+    def __init__(self, spec: str = ""):
+        self.spec = (spec or "").strip()
+        # kind -> {step: arg}; one-shot entries popped when taken
+        self._step_faults: Dict[str, Dict[int, float]] = {k: {} for k in _STEP_KINDS}
+        # fail point -> [(first_attempt, n_failures)]
+        self._fail_windows: Dict[str, List[Tuple[int, int]]] = {}
+        self._attempts: Counter = Counter()
+        self._lock = threading.Lock()
+        for raw in self.spec.split(";"):
+            entry = raw.strip()
+            if not entry:
+                continue
+            self._parse_entry(entry)
+
+    def _parse_entry(self, entry: str) -> None:
+        try:
+            kind, rest = entry.split("@", 1)
+            parts = rest.split(":", 1)
+            step = int(parts[0])
+            arg = parts[1] if len(parts) > 1 else None
+        except ValueError:
+            raise ValueError(
+                f"bad {ENV_VAR} entry {entry!r}: want kind@step[:arg]"
+            ) from None
+        kind = kind.strip()
+        if step < 0:
+            raise ValueError(f"bad {ENV_VAR} entry {entry!r}: step must be >= 0")
+        if kind in _POINT_KINDS:
+            n = int(arg) if arg is not None else 1
+            if n < 1:
+                raise ValueError(
+                    f"bad {ENV_VAR} entry {entry!r}: failure count must be >= 1"
+                )
+            self._fail_windows.setdefault(_POINT_KINDS[kind], []).append((step, n))
+        elif kind in _STEP_KINDS:
+            if kind == "kill_worker":
+                val = float(int(arg)) if arg is not None else 0.0
+            elif kind == "stall_step":
+                val = float(arg) if arg is not None else 1.0
+            else:  # nan_batch takes no arg
+                if arg is not None:
+                    raise ValueError(
+                        f"bad {ENV_VAR} entry {entry!r}: nan_batch takes no arg"
+                    )
+                val = 1.0
+            self._step_faults[kind][step] = val
+        else:
+            raise ValueError(
+                f"bad {ENV_VAR} entry {entry!r}: unknown kind {kind!r} "
+                f"(want one of {sorted(_STEP_KINDS) + sorted(_POINT_KINDS)})"
+            )
+
+    @property
+    def active(self) -> bool:
+        return bool(self.spec)
+
+    def take(self, kind: str, step: int) -> Optional[float]:
+        """Consume the one-shot fault ``kind@step``; None when absent.
+
+        Returns the entry's arg (worker index for ``kill_worker``, stall
+        seconds for ``stall_step``, 1.0 for ``nan_batch``).
+        """
+        with self._lock:
+            return self._step_faults[kind].pop(int(step), None)
+
+    def check_fail_point(self, point: str) -> None:
+        """Raise :class:`FaultInjectionError` when this attempt ordinal of
+        ``point`` (e.g. ``ckpt_save``) falls in an injected failure window."""
+        with self._lock:
+            ordinal = self._attempts[point]
+            self._attempts[point] += 1
+            windows = self._fail_windows.get(point, ())
+        for first, n in windows:
+            if first <= ordinal < first + n:
+                bump(f"injected_{point}_failures")
+                raise FaultInjectionError(
+                    f"injected {point} failure (attempt ordinal {ordinal}, "
+                    f"window {first}+{n})"
+                )
+
+
+# ---------------------------------------------------------------- process-global
+_INJECTOR: Optional[FaultInjector] = None
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS: Counter = Counter()
+
+
+def get_injector() -> FaultInjector:
+    """The process injector; lazily parsed from ``PDT_FAULT_SPEC`` (inert
+    when the variable is unset)."""
+    global _INJECTOR
+    if _INJECTOR is None:
+        _INJECTOR = FaultInjector(os.environ.get(ENV_VAR, ""))
+    return _INJECTOR
+
+
+def install(spec: Optional[str]) -> FaultInjector:
+    """Replace the process injector with one parsed from ``spec`` (the
+    config-key path, and the test/bench hook).  ``install(None)`` resets to
+    inert."""
+    global _INJECTOR
+    _INJECTOR = FaultInjector(spec or "")
+    return _INJECTOR
+
+
+def bump(name: str, n: int = 1) -> None:
+    """Increment a process-global recovery counter (thread-safe)."""
+    with _COUNTER_LOCK:
+        _COUNTERS[name] += n
+
+
+def counters() -> Dict[str, int]:
+    """Snapshot of all recovery/injection counters."""
+    with _COUNTER_LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS.clear()
+
+
+def poison_batches(host_iter, injector: FaultInjector, start_iter: int = 0,
+                   logger=None):
+    """Wrap a training batch iterator, applying ``nan_batch`` faults.
+
+    Yields batches unchanged except at injected step indices, where the
+    (float) image/token-input half is replaced with NaNs — the on-device
+    anomaly guard must then skip the step.  Counting starts at
+    ``start_iter`` and stays aligned with the step index because the
+    training stream is strictly ordered (``device_prefetch`` preserves
+    order; a rebuilt stream passes its new start iter).
+    """
+    import numpy as np
+
+    step = start_iter
+    for img, label in host_iter:
+        if injector.take("nan_batch", step) is not None:
+            img = np.asarray(img)
+            if np.issubdtype(img.dtype, np.floating):
+                img = np.full(img.shape, np.nan, dtype=img.dtype)
+                bump("injected_nan_batches")
+                if logger is not None:
+                    logger.warning("fault injection: NaN batch at step %d", step)
+            elif logger is not None:
+                logger.warning(
+                    "fault injection: nan_batch@%d skipped — batch dtype %s "
+                    "cannot carry NaN (float pipelines only)", step, img.dtype
+                )
+        step += 1
+        yield img, label

@@ -39,6 +39,20 @@ class PathSpec(NamedTuple):
     build: Callable  # (Runner, seed, train_dataset) -> None
 
 
+def _anomaly_factor(r):
+    """The ``anomaly_factor`` to hand a step builder: the configured factor
+    when the guard is on, ``None`` (exact ungated program) otherwise."""
+    return r.anomaly_factor if getattr(r, "anomaly_enabled", False) else None
+
+
+def _reject_anomaly(r, path: str):
+    if getattr(r, "anomaly_enabled", False):
+        raise ValueError(
+            "training.fault_tolerance.anomaly is not wired for the "
+            f"{path} execution path (supported: image-dp, ring-sp)"
+        )
+
+
 def _token_shardings(r, mesh, seq_axis):
     """Tokens/targets are [batch, seq]: data axis on rows, the path's
     sequence axis (or None) on columns — same for inputs and labels."""
@@ -56,6 +70,7 @@ def _build_pipeline(r, seed, train_dataset):
     from ..parallel import make_pp_mesh, pp_stack_params, pp_state_shardings
     from .pp_steps import build_pp_lm_eval_step, build_pp_lm_train_step
 
+    _reject_anomaly(r, "pipeline")
     if r.model.depth % r.pipe_par != 0:
         raise ValueError(
             f"model.depth ({r.model.depth}) must be divisible by "
@@ -117,6 +132,7 @@ def _build_gspmd(r, seed, train_dataset):
     from ..parallel.tensor import tp_state_shardings
     from .tp_steps import build_tp_lm_eval_step, build_tp_lm_train_step
 
+    _reject_anomaly(r, "gspmd")
     if r.model.num_heads % r.tensor_par != 0:
         # the Megatron column split lands on whole-head boundaries
         raise ValueError(
@@ -160,6 +176,7 @@ def _build_ring_sp(r, seed, train_dataset):
         r.model, r.optimizer, r.scheduler.lr_fn, r.mesh,
         grad_accum=r.grad_accum,
         label_smoothing=r.label_smoothing,
+        anomaly_factor=_anomaly_factor(r),
     )
     r.eval_step = build_lm_eval_step(r.model, r.mesh)
     _token_shardings(r, r.mesh, SEQUENCE_AXIS)
@@ -192,6 +209,7 @@ def _build_image_dp(r, seed, train_dataset):
         grad_accum=r.grad_accum,
         label_smoothing=r.label_smoothing,
         ema_decay=r.ema_decay,
+        anomaly_factor=_anomaly_factor(r),
     )
     r.eval_step = build_eval_step(r.model, r.mesh, input_norm=r._input_norm)
     r._img_sharding = batch_sharding(r.mesh, ndim=4)

@@ -10,9 +10,22 @@ when set, writes a checkpoint at the CURRENT iteration and stops; the next
 launch resumes from it via the normal ``checkpoint.resume`` path.
 
 Enabled automatically whenever checkpointing is configured (set
-``training.checkpoint.preemption: False`` to opt out).  Signal handlers
-are process-wide and only installable from the main thread; elsewhere the
-guard degrades to an inert flag (documented, logged).
+``training.checkpoint.preemption: False`` to opt out).  The latched signal
+set is configurable — ``training.checkpoint.preemption_signals: [SIGTERM,
+SIGUSR1]`` (names or numbers; see :meth:`PreemptionGuard.parse_signals`) —
+because eviction notices differ by platform: plain SIGTERM on most
+spot/preemptible VMs, but e.g. SIGUSR1-style custom notice hooks on some
+GKE/TPU-VM setups.  Default stays SIGTERM-only.
+
+Non-main-thread degradation: signal handlers are process-wide and
+installable ONLY from the main thread (CPython restriction).  When the
+guard is entered from any other thread — e.g. a Runner driven inside a
+test harness thread or an embedding server — ``__enter__`` logs a warning
+and installs nothing: ``triggered`` stays a plain inert flag (it can still
+be set programmatically, which is exactly what the hung-step watchdog's
+``checkpoint_and_exit`` path does), and ``__exit__`` restores nothing.
+The training run is then simply not preemption-safe rather than crashing
+(unit-tested in tests/test_fault_tolerance.py).
 """
 from __future__ import annotations
 
@@ -42,6 +55,45 @@ class PreemptionGuard:
         self.triggered = False
         self._prev: dict = {}
         self._installed = False
+
+    @staticmethod
+    def parse_signals(spec) -> tuple:
+        """Resolve ``training.checkpoint.preemption_signals`` to signal numbers.
+
+        Accepts a single name/number or a list of them.  Names are
+        case-insensitive and the ``SIG`` prefix is optional (``sigterm``,
+        ``TERM``, ``SIGUSR1`` all work); numbers must be valid signals on
+        this platform.  Returns a non-empty tuple of ``signal.Signals``.
+        """
+        if isinstance(spec, (str, int)):
+            spec = [spec]
+        out = []
+        for s in spec:
+            if isinstance(s, str):
+                name = s.upper()
+                if not name.startswith("SIG"):
+                    name = "SIG" + name
+                sig = getattr(signal.Signals, name, None)
+                if sig is None:
+                    raise ValueError(
+                        f"training.checkpoint.preemption_signals: unknown "
+                        f"signal name {s!r}"
+                    )
+            else:
+                try:
+                    sig = signal.Signals(int(s))
+                except ValueError:
+                    raise ValueError(
+                        f"training.checkpoint.preemption_signals: invalid "
+                        f"signal number {s!r}"
+                    ) from None
+            out.append(sig)
+        if not out:
+            raise ValueError(
+                "training.checkpoint.preemption_signals must name at least "
+                "one signal"
+            )
+        return tuple(out)
 
     def _handler(self, signum, frame):
         # async-signal-safe: ONLY set the flag.  Logging here can self-

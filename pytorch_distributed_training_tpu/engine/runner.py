@@ -31,11 +31,14 @@ metric), optional bf16 compute (``training.dtype: bfloat16``).
 from __future__ import annotations
 
 import logging
+import os
 import time
+from collections import deque
 from logging.handlers import QueueHandler
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import tqdm
 
@@ -53,11 +56,13 @@ from ..optimizers import get_optimizer
 from ..parallel import initialize_distributed
 from ..schedulers import get_scheduler
 from ..utils import enable_compile_cache, make_deterministic, make_iter_dataloader
+from . import fault
 from .checkpoint import Checkpointer
 from .paths import select_path
 from .profiling import TraceProfiler
 from .steps import TrainState
-from .topology import parse_batch, parse_topology
+from .topology import parse_batch, parse_fault_tolerance, parse_topology
+from .watchdog import StepWatchdog
 
 __all__ = ["Runner"]
 
@@ -164,6 +169,23 @@ class Runner:
         # documented config error lives there).
         parse_topology(self, cfg, train_cfg, train_dataset)
         host_batch = parse_batch(self, train_cfg)
+        # Fault-tolerance keys (additive, all off by default) + the fault
+        # injector: the PDT_FAULT_SPEC env var wins over the config key so a
+        # chaos wrapper can override any run (engine/fault.py).
+        parse_fault_tolerance(self, train_cfg)
+        if self.fault_spec and not os.environ.get(fault.ENV_VAR):
+            fault.install(self.fault_spec)
+        self._injector = fault.get_injector()
+        if self._injector.active:
+            self.logger.warning(
+                "fault injection ACTIVE: %s", self._injector.spec
+            )
+        if self.anomaly_enabled:
+            # host-side trailing-median state for the on-device guard: the
+            # history holds APPLIED steps' grad norms only, so one spike
+            # cannot poison its own reference
+            self._gnorm_hist: deque = deque(maxlen=self.anomaly_window)
+            self._consec_anomalies = 0
         n_workers = train_cfg["num_workers"]
         # One controller per host: cfg num_workers = decode threads per host
         # (the reference divides workers among its per-GPU processes, :195 —
@@ -310,27 +332,32 @@ class Runner:
             else None
         )
 
-        # device-side double buffering: the next batch's H2D transfer is
-        # dispatched while the current step computes (the reference's pinned
-        # memory + non_blocking copies, :272-273)
-        iter_generator = device_prefetch(
-            make_iter_dataloader(train_loader, start_iter=self.iter),
-            self._put_batch,
-        )
+        iter_generator = self._make_stream()
 
         # --- preemption safety (engine/preemption.py; beyond reference) -----
-        # SIGTERM (spot/preemptible eviction notice) -> checkpoint at the
-        # current iteration and exit cleanly; the relaunch resumes from it.
-        # Active whenever checkpointing is configured, opt-out via
+        # Eviction notice (default SIGTERM; the latched set is configurable
+        # via ``training.checkpoint.preemption_signals`` for platforms that
+        # notify on other signals) -> checkpoint at the current iteration and
+        # exit cleanly; the relaunch resumes from it.  Active whenever
+        # checkpointing is configured, opt-out via
         # ``training.checkpoint.preemption: False``.
         from .preemption import PreemptionGuard
 
         use_guard = self.checkpointer is not None and train_cfg["checkpoint"].get(
             "preemption", True
         )
-        self._preempt = (
-            PreemptionGuard(logger=self.logger) if use_guard else None
-        )
+        self._preempt = None
+        if use_guard:
+            sigs = PreemptionGuard.parse_signals(
+                train_cfg["checkpoint"].get("preemption_signals", ("SIGTERM",))
+            )
+            self._preempt = PreemptionGuard(signals=sigs, logger=self.logger)
+        if self.watchdog_exit and not use_guard:
+            raise ValueError(
+                "fault_tolerance.watchdog.checkpoint_and_exit needs the "
+                "preemption path: configure training.checkpoint.dir and "
+                "leave checkpoint.preemption enabled"
+            )
         # Multi-process: checkpointer.save is a COLLECTIVE, and the signal
         # may land on one host only (or at different loop positions), so
         # hosts must AGREE on preemption at the same iteration or the save
@@ -349,10 +376,27 @@ class Runner:
                     f"checkpoint.preemption_sync_interval must be >= 1, got "
                     f"{self._preempt_sync}"
                 )
+        # --- hung-step watchdog (engine/watchdog.py; config-gated) ----------
+        self._watchdog = None
+        if self.watchdog_enabled:
+            self._watchdog = StepWatchdog(
+                factor=self.watchdog_factor,
+                min_seconds=self.watchdog_min_seconds,
+                window=self.watchdog_window,
+                warmup=self.watchdog_warmup,
+                poll_seconds=self.watchdog_poll,
+                on_hang=self._on_hang,
+                logger=self.logger,
+            )
+
         import contextlib
 
-        with self._preempt if self._preempt else contextlib.nullcontext():
-            self._train_loop(iter_generator, train_cfg)
+        try:
+            with self._preempt if self._preempt else contextlib.nullcontext():
+                self._train_loop(iter_generator, train_cfg)
+        finally:
+            if self._watchdog:
+                self._watchdog.close()
         if self.profiler:
             self.profiler.finalize()
         if self.checkpointer:
@@ -436,11 +480,153 @@ class Runner:
         )
         return loaded
 
+    # ------------------------------------------------------- fault tolerance
+    def _make_stream(self):
+        """Build the training input stream: epoch iterator (fast-forwarded
+        to ``self.iter``) -> optional NaN-batch injection -> device-side
+        double buffering (the next batch's H2D transfer is dispatched while
+        the current step computes — the reference's pinned memory +
+        non_blocking copies, :272-273).  A rollback rebuilds the whole
+        stream from the restored iteration."""
+        host_iter = make_iter_dataloader(self.train_loader, start_iter=self.iter)
+        if self._injector.active:
+            host_iter = fault.poison_batches(
+                host_iter, self._injector, start_iter=self.iter,
+                logger=self.logger,
+            )
+        return device_prefetch(host_iter, self._put_batch)
+
+    def _apply_step_faults(self):
+        """Fire any host-side injected faults keyed to this step (the
+        NaN-batch fault lives in the stream instead — see _make_stream)."""
+        inj = self._injector
+        if not inj.active:
+            return
+        w = inj.take("kill_worker", self.iter)
+        if w is not None:
+            import signal as _signal
+
+            pool = getattr(self.train_loader, "_pool", None)
+            if pool is None:
+                self.logger.warning(
+                    "fault injection: kill_worker@%d ignored — the loader "
+                    "has no process pool (worker_mode)", self.iter,
+                )
+            else:
+                wid = int(w) % pool.num_workers
+                pid = pool._procs[wid].pid
+                self.logger.warning(
+                    "fault injection: SIGKILL loader worker %d (pid %d) at "
+                    "step %d", wid, pid, self.iter,
+                )
+                os.kill(pid, _signal.SIGKILL)
+        s = inj.take("stall_step", self.iter)
+        if s is not None:
+            self.logger.warning(
+                "fault injection: stalling step %d for %.2fs", self.iter, s
+            )
+            time.sleep(float(s))
+
+    def _on_hang(self, step: int, elapsed: float, limit: float) -> None:
+        """Watchdog diagnostic dump (monitor thread): step identity,
+        per-host progress, loader queue depth, and all-thread stacks."""
+        fault.bump("watchdog_fires")
+        pool = getattr(self.train_loader, "_pool", None)
+        median = self._watchdog.trailing_median()
+        self.logger.error(
+            "watchdog: host %d stuck in step %d for %.1fs (limit %.1fs, "
+            "trailing median %.3fs); loader pool tasks outstanding: %s",
+            self.current_rank, step, elapsed, limit,
+            -1.0 if median is None else median,
+            getattr(pool, "_outstanding", "n/a"),
+        )
+        try:
+            # GIL-safe all-thread dump: sys._current_frames + format_stack
+            # run as ordinary Python, so frame objects stay refcounted while
+            # walked.  (faulthandler.dump_traceback walks OTHER threads'
+            # frames without synchronization — against a main thread busy
+            # inside a compiled step it reads freed frames and segfaults.)
+            import sys
+            import threading
+            import traceback
+
+            names = {t.ident: t.name for t in threading.enumerate()}
+            dump = []
+            for tid, frame in sys._current_frames().items():
+                dump.append(
+                    f"Thread {names.get(tid, '?')} (id {tid}):\n"
+                    + "".join(traceback.format_stack(frame))
+                )
+            self.logger.error("watchdog stack dump:\n%s", "\n".join(dump))
+        except Exception:  # the dump is best-effort diagnostics
+            pass
+        if self.watchdog_exit and self._preempt is not None:
+            # reuse the eviction path: the loop checkpoints at the current
+            # iteration and exits cleanly (multi-host agreement included)
+            self.logger.error(
+                "watchdog: requesting checkpoint-and-exit via the "
+                "preemption flag"
+            )
+            self._preempt.triggered = True
+
+    def _rollback(self, iter_generator, train_cfg):
+        """N consecutive anomalous steps: restore the last checkpoint and
+        rebuild the input stream from the restored iteration."""
+        fault.bump("rollbacks")
+        if self.checkpointer is None:
+            raise RuntimeError(
+                f"{self._consec_anomalies} consecutive anomalous steps at "
+                f"iter {self.iter} and no training.checkpoint configured "
+                "to roll back to"
+            )
+        self.logger.error(
+            "anomaly guard: %d consecutive anomalous steps at iter %d — "
+            "rolling back to the last checkpoint",
+            self._consec_anomalies, self.iter,
+        )
+        try:
+            iter_generator.close()
+        except Exception:  # pragma: no cover - abandoned stream cleanup
+            pass
+        self.checkpointer.wait()  # an async save may still be in flight
+        self.state, start_iter = self.checkpointer.restore_latest(
+            self.state, self.logger
+        )
+        # A restore that hands back non-finite params would immediately
+        # re-trip the anomaly guard and loop rollback -> restore forever;
+        # fail loudly instead (seen in the wild when a stale persistent
+        # compile cache corrupted the restore path).
+        restored_finite = all(
+            bool(jnp.isfinite(leaf).all())
+            for leaf in jax.tree.leaves(self.state.params)
+        )
+        if not restored_finite:
+            raise RuntimeError(
+                f"rollback restore of step {start_iter} returned non-finite "
+                "parameters — checkpoint or restore path is corrupt"
+            )
+        self.iter = start_iter
+        self.scheduler.last_epoch = start_iter
+        self._consec_anomalies = 0
+        self._gnorm_hist.clear()
+        return self._make_stream()
+
     def _train_loop(self, iter_generator, train_cfg):
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
+            if self._watchdog:
+                self._watchdog.step_started(self.iter)
+            self._apply_step_faults()
             g_img, g_label = next(iter_generator)
             self.train_iter(g_img, g_label)
+            if self._watchdog:
+                self._watchdog.step_finished()
+            if (
+                self.anomaly_enabled
+                and self._consec_anomalies >= self.anomaly_max_consec
+            ):
+                iter_generator = self._rollback(iter_generator, train_cfg)
+                continue
             if self._preempt and self._globally_preempted():
                 self.logger.warning(
                     "Preemption signal received: saving checkpoint at iter "
@@ -515,7 +701,29 @@ class Runner:
     def train_iter(self, g_img, g_label):
         """One training iteration on already-device-resident arrays."""
         train_cfg = self.global_cfg["training"]
-        self.state, loss = self.train_step(self.state, g_img, g_label)
+        if self.anomaly_enabled:
+            # the trailing median rides into the compiled step as a python
+            # float (weak-typed scalar: a new value never retraces); the
+            # returned ``applied`` flag is the guard's one extra per-step
+            # host sync — the documented cost of arming it
+            ref = float(np.median(self._gnorm_hist)) if self._gnorm_hist else 0.0
+            self.state, loss, gnorm, applied = self.train_step(
+                self.state, g_img, g_label, ref
+            )
+            if float(applied) >= 0.5:
+                self._gnorm_hist.append(float(gnorm))
+                self._consec_anomalies = 0
+            else:
+                self._consec_anomalies += 1
+                fault.bump("skipped_steps")
+                self.logger.warning(
+                    "anomaly guard: step %d SKIPPED (loss=%g grad_norm=%g, "
+                    "trailing median %g) — %d consecutive",
+                    self.iter, float(loss), float(gnorm), ref,
+                    self._consec_anomalies,
+                )
+        else:
+            self.state, loss = self.train_step(self.state, g_img, g_label)
         self._tput_iters += 1
 
         if self.iter % train_cfg["print_interval"] == 0:

@@ -64,6 +64,7 @@ def build_lm_train_step(
     donate: bool = True,
     grad_accum: int = 1,
     label_smoothing: float = 0.0,
+    anomaly_factor=None,
 ):
     """Compile one DP x SP training iteration for a :class:`TransformerLM`.
 
@@ -75,12 +76,19 @@ def build_lm_train_step(
     a partial sum normalized by the GLOBAL token count, so accumulating
     grad/loss *sums* over micros reproduces the full-batch objective
     exactly.
+
+    ``anomaly_factor``: arm the anomaly-step guard — same contract as
+    :func:`..engine.steps.build_train_step`: the step takes an extra
+    host-fed ``gnorm_ref`` scalar and returns ``(state, loss, gnorm,
+    applied)``, with params/opt-state ``jnp.where``-gated back to their
+    inputs on a non-finite or spiking step.
     """
     axes = (data_axis, seq_axis)
     n_data = mesh.shape[data_axis]
     n_seq = mesh.shape[seq_axis]
+    guard = anomaly_factor is not None
 
-    def body(params, opt_state, tokens, labels):
+    def body(params, opt_state, tokens, labels, *guard_args):
         b_local, s_local = tokens.shape
         global_tokens = b_local * s_local * n_data * n_seq
 
@@ -122,16 +130,55 @@ def build_lm_train_step(
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, labels)
         lr = lr_fn(opt_state.step)
         new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
-        return new_params, new_opt, loss
+        if not guard:
+            return new_params, new_opt, loss
+        (gnorm_ref,) = guard_args
+        # grads are the exact replicated global gradient (psum'd objective)
+        # — the norm matches on every shard, no extra collective
+        gnorm = jnp.sqrt(
+            sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+        )
+        ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        if anomaly_factor > 0:
+            ok = ok & (
+                (gnorm_ref <= 0.0) | (gnorm <= anomaly_factor * gnorm_ref)
+            )
+
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+        return sel(new_params, params), sel(new_opt, opt_state), loss, gnorm, ok
 
     rep = P()
     tok_spec = P(data_axis, seq_axis)
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(rep, rep, tok_spec, tok_spec),
-        out_specs=(rep, rep, rep),
+        in_specs=(rep, rep, tok_spec, tok_spec) + ((rep,) if guard else ()),
+        out_specs=(rep, rep, rep) + ((rep, rep) if guard else ()),
     )
+
+    if guard:
+
+        @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def train_step(state: TrainState, tokens, labels, gnorm_ref):
+            new_params, new_opt, loss, gnorm, ok = sharded(
+                state.params, state.opt_state, tokens, labels, gnorm_ref
+            )
+            return (
+                TrainState(
+                    params=new_params, batch_stats=state.batch_stats,
+                    opt_state=new_opt, ema=state.ema,
+                ),
+                loss,
+                gnorm,
+                ok.astype(jnp.float32),
+            )
+
+        return train_step
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, tokens, labels):

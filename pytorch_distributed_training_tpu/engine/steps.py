@@ -113,6 +113,7 @@ def build_train_step(
     grad_accum: int = 1,
     label_smoothing: float = 0.0,
     ema_decay: Optional[float] = None,
+    anomaly_factor: Optional[float] = None,
 ):
     """Compile the full training iteration as one SPMD program.
 
@@ -145,6 +146,19 @@ def build_train_step(
         average of the updated params, ``ema <- d*ema + (1-d)*params``
         (config ``training.ema.decay``; the Runner evaluates with the EMA
         params when enabled).
+      anomaly_factor: when set, arm the anomaly-step guard (config
+        ``training.fault_tolerance.anomaly``).  The step additionally takes
+        a host-fed ``gnorm_ref`` scalar (trailing-median grad norm; a
+        python float, so feeding a new value never retraces) and computes
+        the global grad norm on-device.  A step whose loss/grad-norm is
+        non-finite — or whose grad norm exceeds ``anomaly_factor *
+        gnorm_ref`` when both are positive (``anomaly_factor == 0`` means
+        non-finite-only) — is SKIPPED: params, BN stats, optimizer state
+        and EMA are ``jnp.where``-gated back to their inputs, so nothing
+        anomalous ever leaves the compiled step and the state stays
+        bitwise-identical.  The step then returns ``(state, loss, gnorm,
+        applied)`` instead of ``(state, loss)``; ``None`` (the default)
+        compiles the exact ungated program.
     """
     normalize = _input_normalizer(input_norm)
 
@@ -184,8 +198,9 @@ def build_train_step(
     # after the shard_map.  Identical math either way (regression-tested in
     # tests/test_profiling.py); the fold only exists for the kernel count.
     fold_ema = ema_decay is not None and getattr(optimizer, "fused", False)
+    guard = anomaly_factor is not None
 
-    def body(params, batch_stats, opt_state, img, label, ema):
+    def body(params, batch_stats, opt_state, img, label, ema, *guard_args):
         if grad_accum > 1:
             b = img.shape[0]
             if b % grad_accum != 0:
@@ -226,7 +241,32 @@ def build_train_step(
         else:
             new_params, new_opt = optimizer.update(grads, opt_state, params, lr)
             new_ema = ema
-        return new_params, new_bs, new_opt, loss, new_ema
+        if not guard:
+            return new_params, new_bs, new_opt, loss, new_ema
+        (gnorm_ref,) = guard_args
+        # grads are already the psum-reduced (replicated) global gradient —
+        # the norm is identical on every replica, no extra collective
+        gnorm = jnp.sqrt(
+            sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+        )
+        ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+        if anomaly_factor > 0:
+            # spike check only once a trailing median exists (ref > 0) —
+            # the first steps of a run have no baseline to spike against
+            ok = ok & (
+                (gnorm_ref <= 0.0) | (gnorm <= anomaly_factor * gnorm_ref)
+            )
+
+        def sel(new, old):
+            return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+        return (
+            sel(new_params, params), sel(new_bs, batch_stats),
+            sel(new_opt, opt_state), loss, sel(new_ema, ema), gnorm, ok,
+        )
 
     rep = P()
     img_spec = P(DATA_AXIS, None, None, None)
@@ -234,9 +274,47 @@ def build_train_step(
     sharded = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(rep, rep, rep, img_spec, label_spec, rep),
-        out_specs=(rep, rep, rep, rep, rep),
+        in_specs=(rep, rep, rep, img_spec, label_spec, rep)
+        + ((rep,) if guard else ()),
+        out_specs=(rep, rep, rep, rep, rep) + ((rep, rep) if guard else ()),
     )
+
+    def _ema_outside(ok, old_ema, new_params):
+        # replicated elementwise update — no collective needed, so it
+        # lives outside the shard_map
+        d = float(ema_decay)
+        if ok is None:
+            return jax.tree.map(
+                lambda e, p: d * e + (1.0 - d) * p, old_ema, new_params
+            )
+        # gated: new_params is already the OLD params on a skipped step, so
+        # an unguarded decay would still drift the EMA toward them
+        return jax.tree.map(
+            lambda e, p: jnp.where(ok, d * e + (1.0 - d) * p, e),
+            old_ema, new_params,
+        )
+
+    if guard:
+
+        @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+        def train_step(state: TrainState, img, label, gnorm_ref):
+            new_params, new_bs, new_opt, loss, new_ema, gnorm, ok = sharded(
+                state.params, state.batch_stats, state.opt_state, img, label,
+                state.ema, gnorm_ref,
+            )
+            if ema_decay is not None and not fold_ema:
+                new_ema = _ema_outside(ok, state.ema, new_params)
+            return (
+                TrainState(
+                    params=new_params, batch_stats=new_bs, opt_state=new_opt,
+                    ema=new_ema,
+                ),
+                loss,
+                gnorm,
+                ok.astype(jnp.float32),
+            )
+
+        return train_step
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, img, label):
@@ -245,12 +323,7 @@ def build_train_step(
             state.ema,
         )
         if ema_decay is not None and not fold_ema:
-            # replicated elementwise update — no collective needed, so it
-            # lives outside the shard_map
-            d = float(ema_decay)
-            new_ema = jax.tree.map(
-                lambda e, p: d * e + (1.0 - d) * p, state.ema, new_params
-            )
+            new_ema = _ema_outside(None, state.ema, new_params)
         return (
             TrainState(
                 params=new_params, batch_stats=new_bs, opt_state=new_opt,

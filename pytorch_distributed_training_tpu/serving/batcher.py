@@ -12,6 +12,19 @@ The batcher is shape-agnostic: it hands the runner a list of
 ``(payload, meta)`` pairs and the runner (``InferenceEngine._run_batch``)
 does the bucketing/padding, so the number of distinct XLA compiles stays
 bounded by the engine's bucket grid, not by client batch arithmetic.
+
+Graceful degradation under overload (both off by default):
+
+  - per-request deadlines (``deadline_ms``): a request still queued past
+    its deadline resolves with ``TimeoutError`` at collection time instead
+    of occupying a flush slot — under backlog, work nobody is waiting for
+    anymore stops displacing work somebody is;
+  - bounded-queue load shedding (``max_backlog``): beyond the configured
+    backlog, ``submit`` fails fast with :class:`OverloadedError` rather
+    than growing an unbounded queue of doomed requests.
+
+Both are counted (``timeouts``/``sheds``) and surfaced through optional
+callbacks so ``ServingMetrics`` can aggregate them.
 """
 from __future__ import annotations
 
@@ -21,19 +34,25 @@ import time
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
-__all__ = ["DynamicBatcher", "Request"]
+__all__ = ["DynamicBatcher", "OverloadedError", "Request"]
+
+
+class OverloadedError(RuntimeError):
+    """Rejected by load shedding: the batcher's backlog is full."""
 
 
 class Request:
     """One queued payload plus its result future and enqueue timestamp."""
 
-    __slots__ = ("payload", "meta", "future", "enqueued_at")
+    __slots__ = ("payload", "meta", "future", "enqueued_at", "deadline")
 
-    def __init__(self, payload, meta):
+    def __init__(self, payload, meta, deadline: Optional[float] = None):
         self.payload = payload
         self.meta = dict(meta)
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
+        # absolute time.monotonic() deadline; None = wait forever
+        self.deadline = deadline
 
 
 class DynamicBatcher:
@@ -50,27 +69,71 @@ class DynamicBatcher:
         run_batch: Callable[[Sequence[Request]], Optional[List[Any]]],
         max_batch_size: int,
         max_delay_ms: float,
+        deadline_ms: Optional[float] = None,
+        max_backlog: Optional[int] = None,
+        on_timeout: Optional[Callable[[], None]] = None,
+        on_shed: Optional[Callable[[], None]] = None,
     ):
         if max_batch_size < 1:
             raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
         if max_delay_ms < 0:
             raise ValueError(f"max_delay_ms must be >= 0, got {max_delay_ms}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if max_backlog is not None and max_backlog < 1:
+            raise ValueError(f"max_backlog must be >= 1, got {max_backlog}")
         self._run_batch = run_batch
         self.max_batch_size = int(max_batch_size)
         self.max_delay = max_delay_ms / 1000.0
+        self.deadline_ms = deadline_ms
+        self.max_backlog = max_backlog
+        self.timeouts = 0
+        self.sheds = 0
+        self._on_timeout = on_timeout
+        self._on_shed = on_shed
         self._queue: "queue.Queue[Optional[Request]]" = queue.Queue()
         self._closed = False
+        self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True
         )
         self._thread.start()
 
-    def submit(self, payload, **meta) -> Future:
-        """Enqueue one request; the future resolves with its result."""
-        if self._closed:
-            raise RuntimeError("batcher is closed")
-        req = Request(payload, meta)
-        self._queue.put(req)
+    def submit(self, payload, deadline_ms: Optional[float] = None, **meta) -> Future:
+        """Enqueue one request; the future resolves with its result.
+
+        ``deadline_ms`` overrides the batcher-level default; a request
+        still queued when its deadline passes resolves with
+        ``TimeoutError``.  Raises ``RuntimeError`` once closed and
+        :class:`OverloadedError` when the backlog bound rejects the
+        request.
+        """
+        dl = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if dl is not None and dl <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {dl}")
+        with self._lock:
+            # under the same lock close() takes: a submit that wins the
+            # race lands before the sentinel and is drained; one that
+            # loses raises — a Future can never be enqueued behind a dead
+            # loop to hang forever
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if (
+                self.max_backlog is not None
+                and self._queue.qsize() >= self.max_backlog
+            ):
+                self.sheds += 1
+                if self._on_shed is not None:
+                    self._on_shed()
+                raise OverloadedError(
+                    f"serving backlog full ({self.max_backlog} waiting); "
+                    "request shed"
+                )
+            req = Request(
+                payload, meta,
+                deadline=(time.monotonic() + dl / 1000.0) if dl else None,
+            )
+            self._queue.put(req)
         return req.future
 
     def depth(self) -> int:
@@ -79,10 +142,11 @@ class DynamicBatcher:
 
     def close(self) -> None:
         """Drain remaining requests, then stop the flush thread."""
-        if self._closed:
-            return
-        self._closed = True
-        self._queue.put(None)  # sentinel wakes a blocked get
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)  # sentinel wakes a blocked get
         self._thread.join()
 
     def __enter__(self):
@@ -93,15 +157,36 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------------ #
 
+    def _expired(self, req: Request) -> bool:
+        """Resolve an over-deadline request with ``TimeoutError``; True if
+        it expired (the caller must not batch it)."""
+        if req.deadline is None or time.monotonic() < req.deadline:
+            return False
+        self.timeouts += 1
+        if self._on_timeout is not None:
+            self._on_timeout()
+        if not req.future.done():
+            req.future.set_exception(
+                TimeoutError(
+                    "serving request exceeded its deadline after "
+                    f"{time.monotonic() - req.enqueued_at:.3f}s in queue"
+                )
+            )
+        return True
+
     def _collect(self) -> Tuple[List[Request], bool]:
         """Block for the first request, then gather until a flush trigger.
 
         Returns ``(batch, stop)``; stop means the sentinel was seen (any
-        gathered batch is still flushed first — close() drains).
+        gathered batch is still flushed first — close() drains).  Requests
+        past their deadline are expired here instead of batched.
         """
-        first = self._queue.get()
-        if first is None:
-            return [], True
+        while True:
+            first = self._queue.get()
+            if first is None:
+                return [], True
+            if not self._expired(first):
+                break
         batch = [first]
         # a backlog that built while the previous batch ran must flush at
         # full width immediately — grab whatever already waits before ever
@@ -115,7 +200,8 @@ class DynamicBatcher:
                 break
             if req is None:
                 return batch, True
-            batch.append(req)
+            if not self._expired(req):
+                batch.append(req)
         deadline = first.enqueued_at + self.max_delay
         while len(batch) < self.max_batch_size:
             remaining = deadline - time.monotonic()
@@ -127,7 +213,8 @@ class DynamicBatcher:
                 break
             if req is None:
                 return batch, True
-            batch.append(req)
+            if not self._expired(req):
+                batch.append(req)
         return batch, False
 
     def _flush(self, batch: List[Request]) -> None:
@@ -165,5 +252,5 @@ class DynamicBatcher:
                         req = self._queue.get_nowait()
                     except queue.Empty:
                         return
-                    if req is not None:
+                    if req is not None and not self._expired(req):
                         self._flush([req])

@@ -69,6 +69,8 @@ class InferenceEngine:
         seq_buckets: Sequence[int],
         max_batch_size: int,
         max_delay_ms: float,
+        deadline_ms: Optional[float] = None,
+        max_backlog: Optional[int] = None,
         max_new_tokens: int = 0,
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
@@ -122,7 +124,12 @@ class InferenceEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._batch_counter = 0
         self.batcher = DynamicBatcher(
-            self._run_batch, max_batch_size, max_delay_ms
+            self._run_batch, max_batch_size, max_delay_ms,
+            deadline_ms=deadline_ms, max_backlog=max_backlog,
+            # degradation events land in the same metrics ledger as
+            # latency/throughput, so one snapshot tells the whole story
+            on_timeout=lambda: self.metrics.incr("timeouts"),
+            on_shed=lambda: self.metrics.incr("sheds"),
         )
 
     # ------------------------------------------------------------------ #
@@ -182,6 +189,14 @@ class InferenceEngine:
             seq_buckets=serve.get("seq_buckets", [16]),
             max_batch_size=max_batch,
             max_delay_ms=float(serve.get("max_delay_ms", 5.0)),
+            deadline_ms=(
+                float(serve["deadline_ms"])
+                if serve.get("deadline_ms") is not None else None
+            ),
+            max_backlog=(
+                int(serve["max_backlog"])
+                if serve.get("max_backlog") is not None else None
+            ),
             max_new_tokens=int(serve.get("max_new_tokens", 16)),
             temperature=float(serve.get("temperature", 0.0)),
             eos_id=serve.get("eos_id"),
@@ -193,8 +208,13 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload):
-        """Validate + enqueue one request; returns its result future."""
+    def submit(self, payload, deadline_ms: Optional[float] = None):
+        """Validate + enqueue one request; returns its result future.
+
+        ``deadline_ms`` overrides the engine's default per-request
+        deadline (``serving.deadline_ms``); past it an unflushed request
+        resolves with ``TimeoutError``.
+        """
         if self.is_lm:
             prompt = np.asarray(payload, np.int32)
             if prompt.ndim != 1 or prompt.size < 1:
@@ -207,12 +227,12 @@ class InferenceEngine:
                     f"prompt length {prompt.size} exceeds largest seq "
                     f"bucket {self.seq_buckets[-1]}"
                 )
-            return self.batcher.submit(prompt)
+            return self.batcher.submit(prompt, deadline_ms=deadline_ms)
         img = np.asarray(payload)
         want = (self.image_size, self.image_size, 3)
         if img.shape != want:
             raise ValueError(f"image payload must have shape {want}, got {img.shape}")
-        return self.batcher.submit(img)
+        return self.batcher.submit(img, deadline_ms=deadline_ms)
 
     def depth(self) -> int:
         return self.batcher.depth()

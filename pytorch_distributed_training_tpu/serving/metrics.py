@@ -34,6 +34,13 @@ class ServingMetrics:
         self._prompt_tokens = 0
         self._prefill_s = 0.0
         self._decode_s = 0.0
+        # degradation/recovery event counters (timeouts, sheds, ...)
+        self._counters: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        """Bump a named degradation counter (e.g. ``timeouts``, ``sheds``)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
 
     def record_batch(
         self,
@@ -88,12 +95,14 @@ class ServingMetrics:
             prompt_tokens = self._prompt_tokens
             prefill_s = self._prefill_s
             decode_s = self._decode_s
+            counters = dict(self._counters)
         out = {
             "requests": int(lat.size),
             "batches": int(sizes.size),
             "items": int(items),
             "max_queue_depth": int(depth),
         }
+        out.update(counters)
         if lat.size:
             out["latency_ms_p50"] = float(np.percentile(lat, 50))
             out["latency_ms_p99"] = float(np.percentile(lat, 99))

@@ -1,0 +1,98 @@
+"""Bounded retry with exponential backoff + jitter (shared I/O policy).
+
+Checkpoint storage on large jobs is the classic transient-failure surface
+(SURVEY.md §5.3: the reference restarts from iter 0 on any failure; the
+at-scale runs ROADMAP targets cannot).  One policy object serves every
+retrying call site — today orbax save/restore in ``engine/checkpoint.py`` —
+so backoff behavior is configured once and tested once.
+
+Design points:
+  - bounded ``attempts`` (never an infinite loop around a broken disk),
+  - exponential backoff ``backoff * 2**attempt`` capped at ``max_backoff``,
+    with multiplicative jitter so a fleet of hosts retrying a shared
+    filesystem doesn't stampede in lockstep,
+  - an exception *allowlist* (``retry_on``): only failures that can
+    plausibly be transient are retried — a ``ValueError`` from a
+    programming bug re-raises on the first attempt,
+  - injectable ``sleep``/``rng`` so tests assert the exact delay sequence
+    without waiting on a wall clock.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["Retry"]
+
+
+class Retry:
+    """Callable retry policy: use as ``policy.call(fn, ...)`` or ``@policy``."""
+
+    def __init__(
+        self,
+        attempts: int = 3,
+        backoff: float = 0.25,
+        max_backoff: float = 8.0,
+        jitter: float = 0.25,
+        retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        if backoff < 0 or max_backoff < 0:
+            raise ValueError(
+                f"backoff/max_backoff must be >= 0, got {backoff}/{max_backoff}"
+            )
+        if not (0.0 <= jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        self.attempts = int(attempts)
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.jitter = float(jitter)
+        self.retry_on = tuple(retry_on)
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
+        self._logger = logger
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (0-based failed attempt)."""
+        base = min(self.backoff * (2.0 ** attempt), self.max_backoff)
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def call(self, fn: Callable, *args, on_retry: Optional[Callable] = None, **kwargs):
+        """Run ``fn(*args, **kwargs)``, retrying allowlisted failures.
+
+        ``on_retry(attempt, exc, delay)`` fires before each backoff sleep
+        (counter hooks); the final failure always re-raises the original
+        exception.
+        """
+        for attempt in range(self.attempts):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as exc:
+                if attempt == self.attempts - 1:
+                    raise
+                d = self.delay(attempt)
+                if on_retry is not None:
+                    on_retry(attempt, exc, d)
+                if self._logger is not None:
+                    self._logger.warning(
+                        "%s failed (attempt %d/%d): %s — retrying in %.2fs",
+                        getattr(fn, "__name__", "call"),
+                        attempt + 1, self.attempts, exc, d,
+                    )
+                self._sleep(d)
+
+    def __call__(self, fn: Callable) -> Callable:
+        """Decorator form: ``@Retry(...)`` wraps ``fn`` with ``call``."""
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, **kwargs)
+
+        return wrapped

@@ -45,6 +45,12 @@ def pytest_configure(config):
         "box (`pytest -m quick`); the full suite needs several 10-minute "
         "windows there (round-3 VERDICT weak #6)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection recovery tests (engine/fault.py harness) — "
+        "spawn/kill pool processes or wait out real watchdog/stall timers, "
+        "so they ride the slow tier, not the default run",
+    )
 
 
 def uses_mesh_axis(sharding, axis: str) -> bool:

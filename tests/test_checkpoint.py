@@ -293,6 +293,58 @@ def test_restore_nonstructural_error_not_misdiagnosed(tmp_path):
     )
 
 
+def test_corrupt_newest_checkpoint_falls_back_to_previous_valid(tmp_path, caplog):
+    """Fault-tolerance satellite: a truncated/corrupt NEWEST checkpoint is
+    skipped with a warning and restore_latest falls back to the newest
+    EARLIER valid step — a partial write during eviction must not brick the
+    relaunch.  (A single corrupt step with nothing to fall back to still
+    raises the raw error: test_restore_nonstructural_error_not_misdiagnosed.)"""
+    import logging
+    import os
+
+    from pytorch_distributed_training_tpu.engine import TrainState, fault
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import replicated_sharding
+    from pytorch_distributed_training_tpu.parallel.mesh import make_mesh
+    from pytorch_distributed_training_tpu.utils.retry import Retry
+
+    opt = SGD(lr=0.1)
+
+    def make_state(fill):
+        params = {"w": jnp.full((4, 4), float(fill))}
+        state = TrainState(
+            params=params, batch_stats={}, opt_state=opt.init(params)
+        )
+        return jax.device_put(state, replicated_sharding(make_mesh()))
+
+    # attempts=1: the corrupt step must fail over to the previous step, not
+    # burn retry backoff on a permanently damaged directory
+    ck = Checkpointer(str(tmp_path / "c"), interval=1, retry=Retry(attempts=1))
+    ck.save(1, make_state(1.0))
+    ck.save(3, make_state(3.0))
+    ck.wait()
+    step_dir = os.path.join(ck.directory, "3")
+    removed = 0
+    for root, dirs, files in os.walk(step_dir):
+        for f in files:
+            if f not in ("_METADATA", "metadata", "manifest.ocdbt"):
+                os.remove(os.path.join(root, f))
+                removed += 1
+    assert removed > 0, "corruption setup removed nothing"
+
+    fault.reset_counters()
+    logger = logging.getLogger("ckpt-fallback-test")
+    with caplog.at_level(logging.WARNING, logger=logger.name):
+        restored, next_iter = ck.restore_latest(make_state(0.0), logger)
+    ck.close()
+    assert next_iter == 2  # step 1 restored, not the corrupt step 3
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]), np.full((4, 4), 1.0)
+    )
+    assert fault.counters().get("ckpt_fallbacks") == 1
+    assert any("falling back" in r.getMessage() for r in caplog.records)
+
+
 def test_orbax_metadata_contract_version_guard(monkeypatch):
     """The layout-vs-corruption discriminator leans on orbax's (undocumented)
     item_metadata tree-structure convention.  The installed orbax must be

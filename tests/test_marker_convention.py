@@ -34,3 +34,48 @@ def test_bench_driving_tests_are_slow_marked():
         "tests driving bench.py must be @pytest.mark.slow (tier-1 runs "
         f"-m 'not slow' in a fixed budget): {offenders}"
     )
+
+
+# Fault-machinery touchpoints: a test exercising these AND a heavy
+# indicator (real process spawns/kills or wall-clock sleeps) is a chaos
+# test and must not ride the default tier.
+_FAULT_MACHINERY = (
+    "FaultInjector",
+    "fault.install",
+    "PDT_FAULT_SPEC",
+    "StepWatchdog",
+    "ProcessLoaderPool",
+)
+_HEAVY_INDICATORS = ("time.sleep(", "os.kill(", "Process(", "subprocess")
+
+
+def test_fault_injection_tests_are_slow_or_chaos_marked():
+    """Fault-injection tests that spawn/kill real processes or wait out
+    sleep-based watchdog timers must carry ``slow`` or ``chaos`` so the
+    tier-1 gate (``-m 'not slow'``) never pays for them.  Scoped to the
+    fault machinery: ordinary subprocess tests elsewhere (e.g. the CLI
+    crash-path test) follow the bench/budget rules above, not this one."""
+    here = pathlib.Path(__file__).parent
+    offenders = []
+    for path in sorted(here.glob("test_*.py")):
+        if path.name == "test_marker_convention.py":
+            continue  # this guard names the machinery without running it
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            body_src = ast.unparse(node)
+            if not any(m in body_src for m in _FAULT_MACHINERY):
+                continue
+            if not any(h in body_src for h in _HEAVY_INDICATORS):
+                continue
+            decorators = [ast.unparse(d) for d in node.decorator_list]
+            if not any("slow" in d or "chaos" in d for d in decorators):
+                offenders.append(f"{path.name}::{node.name}")
+    assert not offenders, (
+        "fault-injection tests that spawn processes or sleep out timers "
+        "must be @pytest.mark.slow or @pytest.mark.chaos: "
+        f"{offenders}"
+    )
