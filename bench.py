@@ -831,6 +831,7 @@ def bench_chaos():
 
       PDT_FAULT_SPEC   override the fault script (engine/fault.py grammar)
       BENCH_CHAOS_ITERS  train_iters (default 12)
+      BENCH_CHAOS_MULTIHOST=0  skip the 2-process kill-peer scenario
     """
     import tempfile
 
@@ -910,6 +911,160 @@ def bench_chaos():
                 "final_iter": final_iter,
                 "completed": final_iter >= iters,
                 **counters,
+            }
+        )
+    )
+    if os.environ.get("BENCH_CHAOS_MULTIHOST") != "0":
+        bench_chaos_multihost()
+
+
+def _mh_spawn(rank, num_nodes, ports, out, tmp, tag, local_devices, extra):
+    """One tests/multihost_worker.py process (the chaos-tier harness the
+    elastic tests drive); logs to <out>.log so sibling pipes can't deadlock."""
+    import subprocess
+
+    worker = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tests",
+        "multihost_worker.py"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(
+        MH_RANK=str(rank),
+        MH_NUM_NODES=str(num_nodes),
+        MH_PORT=",".join(str(p) for p in ports),
+        MH_PORT_FILE=os.path.join(tmp, f"{tag}.port"),
+        MH_OUT=out,
+        MH_LOCAL_DEVICES=str(local_devices),
+        MH_BATCH_DIVISION="world",
+        MH_TASK="lm",
+    )
+    env.update({k: str(v) for k, v in extra.items()})
+    log = open(out + ".log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, worker], env=env, stdout=log,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    proc._log_file = log
+    return proc
+
+
+def bench_chaos_multihost():
+    """Multi-host chaos: kill one of two hosts mid-run, survive, resume.
+
+    The elastic end-to-end from tests/test_elastic.py as a bench scenario:
+    2 processes x 4 CPU devices train the LM task with the heartbeat layer
+    armed; rank 1 SIGKILLs itself at step 5 (``kill_peer@5``) while rank 0
+    stalls past the heartbeat timeout (``stall_step@5:2.5``) so the silence
+    ages into a diagnosed PeerLostError + emergency save instead of a hang.
+    A 1-process x 8-device relaunch then resumes from the resharded
+    emergency checkpoint and finishes.  One JSON line merging the
+    survivor's and the resumer's recovery counters.
+
+    On a JAX whose CPU backend has no cross-process collectives (vanilla
+    pre-graft 0.4.x) the scenario is reported as skipped, not failed —
+    that is a platform limit the single-process chaos line already covers
+    for every other fault layer.
+    """
+    import socket
+    import tempfile
+
+    def free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        try:
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    metric = (
+        "multi-host chaos (2-proc LM, kill_peer@5 -> emergency save -> "
+        "1-proc reshaped resume)"
+    )
+
+    def finish(proc, expect_rc):
+        try:
+            proc.wait(timeout=900)
+        except Exception:
+            proc.kill()
+            proc.wait()
+        proc._log_file.close()
+        with open(proc._log_file.name) as fp:
+            log = fp.read()
+        if proc.returncode != expect_rc:
+            if "Multiprocess computations aren't implemented" in log:
+                return "unsupported"
+            return f"rc={proc.returncode} (wanted {expect_rc}): {log[-400:]}"
+        return None
+
+    iters = int(os.environ.get("BENCH_CHAOS_MH_ITERS", "8"))
+    base = {
+        "MH_TRAIN_ITERS": iters,
+        "MH_CKPT_INTERVAL": 2,
+        "MH_ELASTIC": 1,
+        "MH_HB_INTERVAL": 0.1,
+        "MH_HB_TIMEOUT": 0.75,
+    }
+    with tempfile.TemporaryDirectory(prefix="chaos_mh_") as tmp:
+        base["MH_CKPT_DIR"] = os.path.join(tmp, "ckpt")
+        outs = [os.path.join(tmp, f"rank{r}.json") for r in range(2)]
+        procs = [
+            _mh_spawn(0, 2, free_ports(1), outs[0], tmp, "mh", 4,
+                      {**base, "PDT_FAULT_SPEC": "stall_step@5:2.5"}),
+            _mh_spawn(1, 2, [0], outs[1], tmp, "mh", 4,
+                      {**base, "PDT_FAULT_SPEC": "kill_peer@5"}),
+        ]
+        try:
+            errs = [finish(procs[1], -9), finish(procs[0], 0)]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        if "unsupported" in errs:
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": "recoveries",
+                "vs_baseline": None, "skipped":
+                "no multiprocess CPU support in this JAX build",
+            }))
+            return
+        err = next((e for e in errs if e), None)
+        if err is None:
+            resume_out = os.path.join(tmp, "resume.json")
+            p = _mh_spawn(0, 1, free_ports(1), resume_out, tmp, "resume", 8,
+                          base)
+            err = finish(p, 0)
+        if err:
+            print(json.dumps({
+                "metric": metric, "value": None, "unit": "recoveries",
+                "vs_baseline": None, "error": err, "completed": False,
+            }))
+            return
+        with open(outs[0]) as fp:
+            survivor = json.load(fp)
+        with open(resume_out) as fp:
+            resumed = json.load(fp)
+    merged = dict(survivor["counters"])
+    for k, v in resumed["counters"].items():
+        merged[k] = merged.get(k, 0) + v
+    recoveries = sum(
+        merged.get(k, 0)
+        for k in ("peer_lost", "elastic_saves", "elastic_restores")
+    )
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": recoveries,
+                "unit": "recoveries",
+                "vs_baseline": None,
+                "survivor_final_iter": survivor["final_iter"],
+                "dead_ranks": survivor.get("dead_ranks"),
+                "resumed_final_iter": resumed["final_iter"],
+                "completed": resumed["final_iter"] >= iters,
+                **merged,
             }
         )
     )

@@ -109,8 +109,19 @@ class DataLoader:
     def skip_next(self, n_batches: int) -> None:
         """Skip the first ``n_batches`` of the NEXT iteration only — an
         index-level fast-forward (no decode cost) used by checkpoint resume
-        to re-align the data stream with the restored iteration counter."""
-        self._skip_next = int(n_batches)
+        to re-align the data stream with the restored iteration counter.
+
+        Negative ``n_batches`` raises immediately (a corrupted resume
+        offset must fail at the call site, not as a silent negative-slice
+        far from the cause).  ``n_batches`` past the end of the epoch is
+        CLAMPED: the next iteration yields zero batches (that epoch is
+        fully consumed) and the epoch loop moves on — the resume semantics
+        when the saved position was exactly an epoch boundary.
+        """
+        n = int(n_batches)
+        if n < 0:
+            raise ValueError(f"skip_next: n_batches must be >= 0, got {n}")
+        self._skip_next = n
 
     def close(self) -> None:
         """Shut down persistent worker processes (no-op for other modes)."""
@@ -204,7 +215,8 @@ class DataLoader:
         batches = self._batch_indices()
         skip = getattr(self, "_skip_next", 0)
         if skip:
-            batches = batches[skip:]
+            # clamped: skip >= len(batches) consumes the whole epoch
+            batches = batches[min(skip, len(batches)):]
             self._skip_next = 0
         if not batches:
             return iter(())

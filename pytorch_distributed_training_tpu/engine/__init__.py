@@ -3,6 +3,7 @@
 Replaces the reference's L4/L6 layers (``Runner`` process orchestration and
 the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
+from .elastic import ElasticCoordinator, PeerLostError
 from .profiling import TraceProfiler
 from .runner import Runner
 from .sp_steps import build_lm_eval_step, build_lm_train_step
@@ -16,6 +17,8 @@ from .steps import (
 from .tp_steps import build_tp_lm_train_step
 
 __all__ = [
+    "ElasticCoordinator",
+    "PeerLostError",
     "Runner",
     "TraceProfiler",
     "TrainState",

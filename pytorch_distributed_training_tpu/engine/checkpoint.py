@@ -18,9 +18,33 @@ reference exactly:
 Saved payload: the full replicated ``TrainState`` (params, BN running stats,
 optimizer momentum + step) — everything needed to resume bit-exact (the
 host-side scheduler state is derived from the step counter).
+
+Elastic additions (README "Elastic recovery"):
+
+  - ``save(it, state, extras=...)`` also writes a tiny JSON *sidecar*
+    (``pipeline_<it>.json``, rank 0 only) carrying the input-pipeline
+    position (epoch, batches consumed this epoch, sampler seed) plus the
+    saving topology (process count, mesh axis sizes) — what
+    ``Runner`` needs to resume MID-epoch bit-exactly instead of replaying
+    from the epoch start, and what a reshaped restore logs its
+    transformation against.  ``read_extras(step)`` returns it.
+  - ``save_emergency(it, state, extras)``: a LOCAL, non-collective dump
+    (npz + JSON meta) for the peer-death path — orbax's multi-process save
+    is a collective and would hang forever with a dead peer, but in pure
+    DP the state is fully replicated, so any survivor holds all of it
+    (``leaf.addressable_data(0)``) and can save alone.  Refused (loud
+    ``ValueError``) when any leaf is *not* fully replicated — a ZeRO/TP
+    survivor only holds a shard.  ``restore_latest`` prefers an emergency
+    step newer than the newest orbax step, re-placing the host arrays with
+    the *target* state's shardings (so a 2-process dp checkpoint restores
+    onto a 1-process mesh unchanged — mesh-reshape-tolerant by
+    construction, with ``parallel.mesh.adapt_spec`` re-deriving the saved
+    partition specs against the target mesh for the reshape diagnostic).
 """
 from __future__ import annotations
 
+import glob
+import json
 import logging
 import os
 import re
@@ -140,7 +164,7 @@ class Checkpointer:
 
         fault.bump("ckpt_retries")
 
-    def save(self, it: int, state) -> None:
+    def save(self, it: int, state, extras: Optional[dict] = None) -> None:
         import orbax.checkpoint as ocp
 
         from . import fault
@@ -150,6 +174,227 @@ class Checkpointer:
             self._manager.save(it, args=ocp.args.StandardSave(state))
 
         self.retry.call(_save, on_retry=self._count_retry)
+        if extras is not None and jax.process_index() == 0:
+            self._write_extras(it, dict(extras))
+
+    # ------------------------------------------------ pipeline-state sidecar
+    def _extras_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"pipeline_{step}.json")
+
+    def _write_extras(self, step: int, extras: dict) -> None:
+        """Atomically write the input-pipeline sidecar for ``step`` and
+        prune sidecars of garbage-collected checkpoint steps (best effort
+        — an orphan sidecar is harmless, its step is never restored)."""
+        tmp = self._extras_path(step) + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(extras, fp)
+        os.replace(tmp, self._extras_path(step))
+        try:
+            keep = set(self.all_steps()) | {step}
+            for path in glob.glob(os.path.join(self.directory, "pipeline_*.json")):
+                m = re.match(r"pipeline_(\d+)\.json$", os.path.basename(path))
+                if m and int(m.group(1)) not in keep:
+                    os.remove(path)
+        except OSError:
+            pass
+
+    def read_extras(self, step: int) -> Optional[dict]:
+        """The sidecar saved alongside checkpoint ``step`` (periodic sidecar
+        first, then the emergency meta), or None when absent/unreadable —
+        the caller falls back to deriving the pipeline position from the
+        step counter (pre-sidecar behavior)."""
+        for path in (self._extras_path(step),) + tuple(
+            sorted(
+                glob.glob(
+                    os.path.join(
+                        self.directory, "emergency", str(step), "meta_rank*.json"
+                    )
+                )
+            )
+        ):
+            try:
+                with open(path) as fp:
+                    payload = json.load(fp)
+            except (OSError, ValueError):
+                continue
+            return payload.get("extras", payload)
+        return None
+
+    # --------------------------------------------------- emergency (elastic)
+    def _emergency_dir(self, step: int) -> str:
+        return os.path.join(self.directory, "emergency", str(step))
+
+    def latest_emergency(self) -> Optional[int]:
+        """Newest emergency-checkpoint step with a committed meta file."""
+        steps = []
+        for meta in glob.glob(
+            os.path.join(self.directory, "emergency", "*", "meta_rank*.json")
+        ):
+            name = os.path.basename(os.path.dirname(meta))
+            if name.isdigit():
+                steps.append(int(name))
+        return max(steps) if steps else None
+
+    def save_emergency(
+        self, it: int, state, extras: Optional[dict] = None
+    ) -> str:
+        """LOCAL, non-collective dump of the (fully replicated) state.
+
+        The peer-death escape hatch: with a dead peer the orbax save's
+        process barrier never completes, but a pure-DP survivor holds the
+        entire state in every leaf's local shard.  Writes
+        ``emergency/<it>/state_rank<r>.npz`` + ``meta_rank<r>.json`` (meta
+        last = commit marker; per-rank names so multiple survivors cannot
+        collide).  Raises ``ValueError`` when any leaf is not fully
+        replicated — a ZeRO/TP shard-holder cannot save alone.
+        """
+        import numpy as np
+
+        from ..parallel.mesh import mesh_axis_sizes
+        from . import fault
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {}
+        specs = {}
+        mesh_desc = None
+        for path, leaf in flat:
+            key = "/".join(
+                str(getattr(k, "key", getattr(k, "name", k))) for k in path
+            )
+            if isinstance(leaf, jax.Array):
+                sh = leaf.sharding
+                if not getattr(sh, "is_fully_replicated", True):
+                    raise ValueError(
+                        f"emergency checkpoint requires a fully replicated "
+                        f"state (pure DP); leaf {key!r} is sharded ({sh}) — "
+                        "a single survivor only holds one shard of it"
+                    )
+                if isinstance(sh, jax.sharding.NamedSharding):
+                    if mesh_desc is None:
+                        mesh_desc = mesh_axis_sizes(sh.mesh)
+                    specs[key] = [
+                        list(e) if isinstance(e, tuple) else e
+                        for e in tuple(sh.spec)
+                    ]
+                arrays[key] = np.asarray(leaf.addressable_data(0))
+            else:
+                arrays[key] = np.asarray(leaf)
+        rank = jax.process_index()
+        out_dir = self._emergency_dir(it)
+        os.makedirs(out_dir, exist_ok=True)
+        npz = os.path.join(out_dir, f"state_rank{rank}.npz")
+        tmp = npz + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fp:
+            np.savez(fp, **arrays)
+        os.replace(tmp, npz)
+        meta = {
+            "step": int(it),
+            "saved_by_rank": int(rank),
+            "process_count": int(jax.process_count()),
+            "mesh": mesh_desc,
+            "specs": specs,
+            "extras": dict(extras) if extras else None,
+        }
+        meta_path = os.path.join(out_dir, f"meta_rank{rank}.json")
+        tmp = meta_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(meta, fp)
+        os.replace(tmp, meta_path)
+        fault.bump("elastic_saves")
+        # older emergency dumps are superseded (restore only ever reads the
+        # newest); prune best-effort
+        try:
+            for other in glob.glob(os.path.join(self.directory, "emergency", "*")):
+                name = os.path.basename(other)
+                if name.isdigit() and int(name) < it:
+                    import shutil
+
+                    shutil.rmtree(other, ignore_errors=True)
+        except OSError:
+            pass
+        return npz
+
+    def _restore_emergency(
+        self, step: int, state, logger: Optional[logging.Logger] = None
+    ) -> Tuple[Any, int]:
+        """Rebuild ``state`` from an emergency npz dump, placing the host
+        arrays with the TARGET state's shardings — the mesh-reshape-tolerant
+        restore: the saved topology only survives as metadata (logged), the
+        target topology decides placement."""
+        import numpy as np
+
+        from ..parallel.mesh import adapt_spec, mesh_axis_sizes
+        from . import fault
+
+        out_dir = self._emergency_dir(step)
+        metas = sorted(glob.glob(os.path.join(out_dir, "meta_rank*.json")))
+        if not metas:
+            raise FileNotFoundError(f"no committed emergency meta in {out_dir}")
+        with open(metas[0]) as fp:
+            meta = json.load(fp)
+        rank = int(meta.get("saved_by_rank", 0))
+        with np.load(os.path.join(out_dir, f"state_rank{rank}.npz")) as npz:
+            saved = {k: npz[k] for k in npz.files}
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+        target_keys = [
+            "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in p)
+            for p, _ in flat
+        ]
+        missing = set(target_keys) - set(saved)
+        extra = set(saved) - set(target_keys)
+        if missing or extra:
+            raise RuntimeError(
+                f"emergency checkpoint at {out_dir} does not match the run's "
+                f"state tree (missing: {sorted(missing)[:4]}, unexpected: "
+                f"{sorted(extra)[:4]}) — was it written by a different "
+                "model/optimizer config?"
+            )
+        leaves = []
+        for key, (_, target_leaf) in zip(target_keys, flat):
+            arr = saved[key]
+            if tuple(arr.shape) != tuple(np.shape(target_leaf)):
+                raise RuntimeError(
+                    f"emergency checkpoint leaf {key!r} has global shape "
+                    f"{tuple(arr.shape)} but the target expects "
+                    f"{tuple(np.shape(target_leaf))} — the mesh reshape "
+                    "changed a GLOBAL shape, which only a different model "
+                    "config can do"
+                )
+            leaves.append(arr)
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+        restored = jax.device_put(
+            tree, jax.tree.map(lambda leaf: leaf.sharding, state)
+        )
+        if logger:
+            target_mesh = next(
+                (
+                    leaf.sharding.mesh
+                    for _, leaf in flat
+                    if isinstance(leaf, jax.Array)
+                    and isinstance(leaf.sharding, jax.sharding.NamedSharding)
+                ),
+                None,
+            )
+            respec = 0
+            if target_mesh is not None:
+                for key, spec in (meta.get("specs") or {}).items():
+                    saved_spec = tuple(
+                        tuple(e) if isinstance(e, list) else e for e in spec
+                    )
+                    if tuple(adapt_spec(saved_spec, target_mesh)) != saved_spec:
+                        respec += 1
+            logger.info(
+                "Restored EMERGENCY checkpoint at iter %d from %s: saved by "
+                "rank %d under mesh %s across %s process(es), re-placed onto "
+                "mesh %s across %d process(es) (%d leaf spec(s) re-derived)",
+                step, out_dir, rank, meta.get("mesh"),
+                meta.get("process_count"),
+                None if target_mesh is None else mesh_axis_sizes(target_mesh),
+                jax.process_count(), respec,
+            )
+        fault.bump("elastic_restores")
+        return restored, step + 1
 
     def restore_latest(
         self, state, logger: Optional[logging.Logger] = None
@@ -158,14 +403,28 @@ class Checkpointer:
         structure/shardings.
 
         Returns ``(state, next_iter)``; ``(state, 0)`` when no checkpoint
-        exists yet.  A newest step that stays unreadable after retries is
-        skipped with a warning and the next-older step is tried; only when
-        every step fails does the NEWEST step's error re-raise (the most
-        actionable one — it names the checkpoint a resume would want).
+        exists yet.  An emergency (peer-death) dump newer than the newest
+        orbax step is preferred — it is by definition the latest committed
+        state — and falls back to the orbax steps if unreadable.  A newest
+        orbax step that stays unreadable after retries is skipped with a
+        warning and the next-older step is tried; only when every step
+        fails does the NEWEST step's error re-raise (the most actionable
+        one — it names the checkpoint a resume would want).
         """
         from . import fault
 
         steps = self.all_steps()
+        emergency = self.latest_emergency()
+        if emergency is not None and (not steps or emergency >= steps[-1]):
+            try:
+                return self._restore_emergency(emergency, state, logger)
+            except Exception as e:
+                fault.bump("ckpt_fallbacks")
+                (logger or logging.getLogger(__name__)).warning(
+                    "emergency checkpoint step %d at %s is unreadable "
+                    "(%s: %s) — falling back to the orbax steps",
+                    emergency, self.directory, type(e).__name__, e,
+                )
         if not steps:
             return state, 0
         first_err: Optional[BaseException] = None

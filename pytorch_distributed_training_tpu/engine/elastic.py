@@ -1,0 +1,280 @@
+"""Elastic multi-host coordination: heartbeats, peer liveness, hang guard.
+
+The multi-host failure mode PR 3's single-process fault-tolerance layer
+cannot touch: a peer process dies (spot eviction, OOM kill, hardware fault)
+and every survivor blocks FOREVER inside the next collective — the XLA
+all-reduce simply never completes, the watchdog can only dump stacks, and
+the job burns its remaining allocation doing nothing.  This module turns
+that indefinite hang into a *diagnosed, bounded* failure:
+
+  - every process writes a per-rank heartbeat file (JSON: rank, pid,
+    generation, seq) into a shared directory every ``heartbeat_interval``
+    seconds from a daemon thread — alive means "recently mtime-touched",
+    independent of where the main thread is blocked;
+  - ``check_peers()`` stats the peer files and raises :class:`PeerLostError`
+    naming every peer whose heartbeat is staler than ``timeout`` (and the
+    age it was last seen at) — called at the top of each training step,
+    before the step's first collective is dispatched;
+  - ``guard(fn)`` runs a blocking call (the step's device sync — the point
+    where a dead peer's unfinished collective would wedge the host) on a
+    side thread while the caller polls peer liveness: peer death mid-
+    collective surfaces as the same ``PeerLostError`` within one timeout,
+    never an indefinite hang;
+  - a *generation counter* persisted in the heartbeat file increments each
+    time a rank restarts into the same directory, so survivors can tell a
+    rejoined peer from a stale file of a dead one (``peer_restarts``
+    counter).
+
+File mtime is the liveness clock (portable stat; on a shared filesystem
+this assumes loosely synchronized host clocks — the same assumption the
+checkpoint step numbering already makes).  Import-light on purpose
+(stdlib only, like :mod:`.fault`): the runner passes its rank/world size
+in, so tests can drive coordinators without a JAX distributed runtime.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from . import fault
+
+__all__ = ["ElasticCoordinator", "PeerLostError"]
+
+
+class PeerLostError(RuntimeError):
+    """A peer process's heartbeat went stale: it is presumed dead.
+
+    Attributes:
+      dead_ranks: ranks whose heartbeat exceeded the timeout (or never
+        appeared within the startup grace window).
+      mid_step: True when the loss was detected while this process was
+        blocked inside a step's collective — the in-flight step's results
+        are unrecoverable, so the emergency checkpoint path must not touch
+        the current state (the last periodic checkpoint is the resume
+        point instead).
+    """
+
+    def __init__(self, message: str, dead_ranks=(), mid_step: bool = False):
+        super().__init__(message)
+        self.dead_ranks = tuple(dead_ranks)
+        self.mid_step = bool(mid_step)
+
+
+class ElasticCoordinator:
+    """Per-process heartbeat writer + peer-liveness detector."""
+
+    def __init__(
+        self,
+        directory: str,
+        process_index: int,
+        num_processes: int,
+        heartbeat_interval: float = 0.5,
+        timeout: float = 5.0,
+        startup_grace: Optional[float] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if heartbeat_interval <= 0:
+            raise ValueError(
+                f"heartbeat_interval must be > 0, got {heartbeat_interval}"
+            )
+        if timeout <= heartbeat_interval:
+            # a timeout within one beat period would flag live peers on any
+            # scheduling hiccup — reject the footgun at construction
+            raise ValueError(
+                f"timeout ({timeout}) must exceed heartbeat_interval "
+                f"({heartbeat_interval})"
+            )
+        self.directory = os.path.abspath(os.path.expanduser(directory))
+        self.process_index = int(process_index)
+        self.num_processes = int(num_processes)
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.timeout = float(timeout)
+        # peers that have not yet written a first beat are only "lost" once
+        # the startup allowance passes (coordinator/service bring-up skew);
+        # compile time is NOT in this window — the beat thread runs through it
+        self.startup_grace = (
+            float(startup_grace) if startup_grace is not None else
+            max(30.0, 4.0 * self.timeout)
+        )
+        self.generation = 0
+        self._logger = logger
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at: Optional[float] = None
+        self._peer_generations: Dict[int, int] = {}
+
+    # ------------------------------------------------------------ heartbeat
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"heartbeat_{rank}.json")
+
+    def _write_beat(self, stopped: bool = False) -> None:
+        payload = {
+            "rank": self.process_index,
+            "pid": os.getpid(),
+            "generation": self.generation,
+            "seq": self._seq,
+            "time": time.time(),
+            "stopped": stopped,
+        }
+        self._seq += 1
+        tmp = self._path(self.process_index) + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(payload, fp)
+        os.replace(tmp, self._path(self.process_index))  # atomic vs readers
+
+    def start(self) -> "ElasticCoordinator":
+        """Write the first beat (bumping the generation past any previous
+        incarnation's) and start the daemon beat thread."""
+        os.makedirs(self.directory, exist_ok=True)
+        prior = self._read(self._path(self.process_index))
+        if prior is not None:
+            self.generation = int(prior.get("generation", -1)) + 1
+            if self._logger:
+                self._logger.info(
+                    "elastic: rank %d rejoining as generation %d",
+                    self.process_index, self.generation,
+                )
+        self._started_at = time.monotonic()
+        self._write_beat()
+        self._thread = threading.Thread(
+            target=self._beat_loop, name="elastic-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self._write_beat()
+            except OSError:  # transient shared-fs error: next beat retries
+                pass
+
+    def close(self) -> None:
+        """Stop the beat thread and mark this rank's file cleanly stopped."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0 * self.heartbeat_interval + 1.0)
+            self._thread = None
+        try:
+            self._write_beat(stopped=True)
+        except OSError:
+            pass
+
+    # --------------------------------------------------------- peer liveness
+    @staticmethod
+    def _read(path: str) -> Optional[dict]:
+        try:
+            with open(path) as fp:
+                return json.load(fp)
+        except (OSError, ValueError):
+            # missing, or caught mid-replace on a non-atomic network fs
+            return None
+
+    def check_peers(self, mid_step: bool = False) -> None:
+        """Raise :class:`PeerLostError` if any peer's heartbeat is stale.
+
+        A peer file older than ``timeout`` (by mtime) means the writer
+        thread died — with the process.  A file that never appeared is only
+        fatal after ``startup_grace``.  A generation bump on a live peer
+        (it restarted into the same directory) is logged and counted, not
+        an error.
+        """
+        if self.num_processes <= 1:
+            return
+        now = time.time()
+        since_start = (
+            time.monotonic() - self._started_at
+            if self._started_at is not None else 0.0
+        )
+        dead = []
+        for rank in range(self.num_processes):
+            if rank == self.process_index:
+                continue
+            path = self._path(rank)
+            try:
+                age = now - os.stat(path).st_mtime
+            except OSError:
+                if since_start > self.startup_grace:
+                    dead.append((rank, None))
+                continue
+            payload = self._read(path) or {}
+            gen = int(payload.get("generation", 0))
+            prev_gen = self._peer_generations.get(rank)
+            if prev_gen is not None and gen > prev_gen:
+                fault.bump("peer_restarts")
+                if self._logger:
+                    self._logger.info(
+                        "elastic: peer rank %d restarted (generation %d -> %d)",
+                        rank, prev_gen, gen,
+                    )
+            self._peer_generations[rank] = gen
+            if age > self.timeout:
+                dead.append((rank, age))
+        if not dead:
+            return
+        parts = []
+        for rank, age in dead:
+            if age is None:
+                parts.append(
+                    f"rank {rank}: no heartbeat within {self.startup_grace:.1f}s "
+                    "startup grace"
+                )
+            else:
+                parts.append(f"rank {rank}: last heartbeat {age:.1f}s ago")
+        raise PeerLostError(
+            f"peer(s) presumed dead (heartbeat timeout {self.timeout:.1f}s, "
+            f"dir {self.directory}): " + "; ".join(parts),
+            dead_ranks=[r for r, _ in dead],
+            mid_step=mid_step,
+        )
+
+    # ---------------------------------------------------------- hang guard
+    def guard(self, fn: Callable, *args, what: str = "step sync"):
+        """Run blocking ``fn(*args)`` with bounded-hang peer detection.
+
+        ``fn`` is the host-blocking point of a training step (the device
+        sync on the step's outputs — the first place a dead peer's
+        unfinished collective wedges the host).  It runs on a daemon side
+        thread while this (main) thread polls ``check_peers``; if a peer
+        dies mid-collective the poll raises :class:`PeerLostError` (with
+        ``mid_step=True``) within roughly one timeout instead of blocking
+        forever.  The abandoned daemon thread stays wedged in the runtime —
+        the caller's contract is to checkpoint-and-exit, not to resume
+        collectives on a broken world.
+        """
+        if self.num_processes <= 1:
+            return fn(*args)
+        box: dict = {}
+        done = threading.Event()
+
+        def _run():
+            try:
+                box["result"] = fn(*args)
+            except BaseException as e:  # re-raised on the caller thread
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run, name="elastic-guarded", daemon=True)
+        started = time.monotonic()
+        t.start()
+        poll = min(self.heartbeat_interval, self.timeout / 4.0)
+        while not done.wait(poll):
+            try:
+                self.check_peers(mid_step=True)
+            except PeerLostError as e:
+                blocked = time.monotonic() - started
+                raise PeerLostError(
+                    f"{e} — detected while blocked in {what} for "
+                    f"{blocked:.1f}s; the in-flight step is unrecoverable",
+                    dead_ranks=e.dead_ranks,
+                    mid_step=True,
+                ) from None
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")

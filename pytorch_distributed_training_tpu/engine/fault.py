@@ -17,6 +17,12 @@ Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
                        (the pool must respawn it, no batch lost)
     stall_step@K[:SEC] sleep SEC (default 1.0) inside step K's host window
                        (the watchdog must fire)
+    kill_peer@K[:R]    SIGKILL THIS training process at step K when its
+                       process index is R (default -1 = any rank) — the
+                       multi-host peer-death scenario: surviving ranks must
+                       detect the silence via the elastic heartbeat layer
+                       (engine/elastic.py) instead of hanging in the next
+                       collective
     ckpt_fail@A[:N]    fail checkpoint-save attempts A..A+N-1 (0-based
                        attempt ordinal across the process; the retry policy
                        must absorb them)
@@ -53,7 +59,7 @@ __all__ = [
 
 ENV_VAR = "PDT_FAULT_SPEC"
 
-_STEP_KINDS = ("nan_batch", "kill_worker", "stall_step")
+_STEP_KINDS = ("nan_batch", "kill_worker", "stall_step", "kill_peer")
 _POINT_KINDS = {"ckpt_fail": "ckpt_save", "restore_fail": "ckpt_restore"}
 
 
@@ -106,6 +112,9 @@ class FaultInjector:
         elif kind in _STEP_KINDS:
             if kind == "kill_worker":
                 val = float(int(arg)) if arg is not None else 0.0
+            elif kind == "kill_peer":
+                # arg = target process index; -1 = whichever rank parses it
+                val = float(int(arg)) if arg is not None else -1.0
             elif kind == "stall_step":
                 val = float(arg) if arg is not None else 1.0
             else:  # nan_batch takes no arg

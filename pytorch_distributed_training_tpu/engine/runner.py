@@ -58,10 +58,16 @@ from ..schedulers import get_scheduler
 from ..utils import enable_compile_cache, make_deterministic, make_iter_dataloader
 from . import fault
 from .checkpoint import Checkpointer
+from .elastic import ElasticCoordinator, PeerLostError
 from .paths import select_path
 from .profiling import TraceProfiler
 from .steps import TrainState
-from .topology import parse_batch, parse_fault_tolerance, parse_topology
+from .topology import (
+    parse_batch,
+    parse_elastic,
+    parse_fault_tolerance,
+    parse_topology,
+)
 from .watchdog import StepWatchdog
 
 __all__ = ["Runner"]
@@ -173,6 +179,9 @@ class Runner:
         # injector: the PDT_FAULT_SPEC env var wins over the config key so a
         # chaos wrapper can override any run (engine/fault.py).
         parse_fault_tolerance(self, train_cfg)
+        # Elastic multi-host recovery keys (additive, off by default):
+        # heartbeat coordinator + peer-loss guard (engine/elastic.py).
+        parse_elastic(self, train_cfg)
         if self.fault_spec and not os.environ.get(fault.ENV_VAR):
             fault.install(self.fault_spec)
         self._injector = fault.get_injector()
@@ -325,6 +334,35 @@ class Runner:
                     "clear the directory or point checkpoint.dir elsewhere"
                 )
 
+        # --- input-pipeline position (mid-epoch resume; elastic layer) ------
+        # (epoch, batches consumed this epoch) — persisted as a sidecar next
+        # to every checkpoint so a resume (even at a DIFFERENT topology under
+        # batch_division: world, where batches/epoch is world-invariant)
+        # restarts the stream on exactly the next unseen batch.
+        self._init_pipeline_position()
+
+        # --- elastic heartbeat coordinator (engine/elastic.py; config-gated) -
+        self._elastic = None
+        if self.elastic_enabled:
+            hb_dir = self.elastic_dir or os.path.join(
+                self.checkpointer.directory, "heartbeats"
+            )
+            self._elastic = ElasticCoordinator(
+                hb_dir,
+                process_index=jax.process_index(),
+                num_processes=jax.process_count(),
+                heartbeat_interval=self.elastic_heartbeat_interval,
+                timeout=self.elastic_timeout,
+                startup_grace=self.elastic_startup_grace,
+                logger=self.logger,
+            )
+            self._elastic.start()
+            self.logger.info(
+                "elastic recovery ON: heartbeats in %s every %.2fs, peer "
+                "timeout %.2fs", hb_dir, self.elastic_heartbeat_interval,
+                self.elastic_timeout,
+            )
+
         # --- optional jax.profiler trace window (absent in reference; §5.1) --
         self.profiler = (
             TraceProfiler.from_config(train_cfg, self.logger)
@@ -394,9 +432,17 @@ class Runner:
         try:
             with self._preempt if self._preempt else contextlib.nullcontext():
                 self._train_loop(iter_generator, train_cfg)
+        except PeerLostError as e:
+            # diagnosed dead peer: emergency-checkpoint what this process can
+            # still save, then propagate — the caller relaunches at the new
+            # world size and the restore path picks the emergency step up
+            self._on_peer_lost(e)
+            raise
         finally:
             if self._watchdog:
                 self._watchdog.close()
+            if self._elastic:
+                self._elastic.close()
         if self.profiler:
             self.profiler.finalize()
         if self.checkpointer:
@@ -481,6 +527,57 @@ class Runner:
         return loaded
 
     # ------------------------------------------------------- fault tolerance
+    def _init_pipeline_position(self):
+        """Set (``_epoch``, ``_batch_in_epoch``) for the NEXT batch to draw.
+
+        Preference order: the persisted sidecar of the checkpoint we resumed
+        from (topology-independent under ``batch_division: world`` — a mesh
+        reshape changes neither the global batch nor batches/epoch), else
+        derive from the step counter and the CURRENT epoch length (exact
+        whenever the topology didn't change)."""
+        self._batches_per_epoch = len(self.train_loader)
+        self._epoch, self._batch_in_epoch = divmod(
+            self.iter, self._batches_per_epoch
+        )
+        if self.checkpointer is None or self.iter == 0:
+            return
+        extras = self.checkpointer.read_extras(self.iter - 1)
+        if extras is None:
+            return
+        saved_bpe = int(extras.get("batches_per_epoch", self._batches_per_epoch))
+        if saved_bpe != self._batches_per_epoch:
+            self.logger.warning(
+                "pipeline sidecar was written with %d batches/epoch but this "
+                "topology yields %d — resuming at its recorded position, but "
+                "bit-exact batch identity is not guaranteed (is "
+                "training.batch_division 'world' on both runs?)",
+                saved_bpe, self._batches_per_epoch,
+            )
+        self._epoch = int(extras["epoch"])
+        self._batch_in_epoch = int(extras["batch_in_epoch"])
+        self.logger.info(
+            "pipeline position restored from sidecar: epoch %d, %d/%d "
+            "batches consumed", self._epoch, self._batch_in_epoch,
+            self._batches_per_epoch,
+        )
+
+    def _pipeline_extras(self) -> dict:
+        """The sidecar payload persisted with each checkpoint (JSON-safe)."""
+        return {
+            "epoch": int(self._epoch),
+            "batch_in_epoch": int(self._batch_in_epoch),
+            "seed": int(self.seed) if self.seed is not None else 0,
+            "world_processes": int(jax.process_count()),
+            "batches_per_epoch": int(self._batches_per_epoch),
+        }
+
+    def _advance_pipeline(self):
+        """Account one consumed training batch (called once per step)."""
+        self._batch_in_epoch += 1
+        if self._batch_in_epoch >= self._batches_per_epoch:
+            self._epoch += 1
+            self._batch_in_epoch = 0
+
     def _make_stream(self):
         """Build the training input stream: epoch iterator (fast-forwarded
         to ``self.iter``) -> optional NaN-batch injection -> device-side
@@ -488,7 +585,12 @@ class Runner:
         the current step computes — the reference's pinned memory +
         non_blocking copies, :272-273).  A rollback rebuilds the whole
         stream from the restored iteration."""
-        host_iter = make_iter_dataloader(self.train_loader, start_iter=self.iter)
+        host_iter = make_iter_dataloader(
+            self.train_loader,
+            start_iter=self.iter,
+            start_epoch=self._epoch,
+            skip_batches=self._batch_in_epoch,
+        )
         if self._injector.active:
             host_iter = fault.poison_batches(
                 host_iter, self._injector, start_iter=self.iter,
@@ -502,6 +604,19 @@ class Runner:
         inj = self._injector
         if not inj.active:
             return
+        k = inj.take("kill_peer", self.iter)
+        if k is not None:
+            target = int(k)
+            if target < 0 or target == jax.process_index():
+                import signal as _signal
+
+                self.logger.error(
+                    "fault injection: kill_peer@%d — SIGKILL self "
+                    "(process %d, pid %d); surviving ranks must detect the "
+                    "silence via the elastic heartbeat layer",
+                    self.iter, jax.process_index(), os.getpid(),
+                )
+                os.kill(os.getpid(), _signal.SIGKILL)
         w = inj.take("kill_worker", self.iter)
         if w is not None:
             import signal as _signal
@@ -569,6 +684,55 @@ class Runner:
             )
             self._preempt.triggered = True
 
+    def _synced_train_iter(self, g_img, g_label):
+        """One training iteration, blocked to completion — elastic mode runs
+        this under :meth:`ElasticCoordinator.guard` so the step's collectives
+        cannot outlive the peer-liveness watch (the per-step sync is the
+        documented cost of enabling elastic recovery)."""
+        self.train_iter(g_img, g_label)
+        jax.block_until_ready(self.state)
+
+    def _on_peer_lost(self, e: PeerLostError):
+        """A peer stopped heartbeating: checkpoint what this process can
+        still save, log the diagnosis, and let the error propagate (the
+        relaunch — possibly at a different world size — resumes from the
+        emergency step via the mesh-reshape-tolerant restore path)."""
+        fault.bump("peer_lost")
+        self.logger.error("elastic recovery: %s", e)
+        if e.mid_step:
+            # the in-flight step donated the previous state's buffers into
+            # an unfinished computation — nothing consistent left to save
+            self.logger.error(
+                "peer died mid-step %d: the in-flight step is unrecoverable; "
+                "the relaunch resumes from the last durable checkpoint",
+                self.iter,
+            )
+            return
+        if self.checkpointer is None or self.iter == 0:
+            self.logger.error(
+                "no emergency checkpoint possible (%s) — the relaunch "
+                "starts from the last durable checkpoint, if any",
+                "no checkpointer configured" if self.checkpointer is None
+                else "no step has completed yet",
+            )
+            return
+        step = self.iter - 1
+        try:
+            path = self.checkpointer.save_emergency(
+                step, self.state, extras=self._pipeline_extras()
+            )
+            self.logger.error(
+                "EMERGENCY checkpoint for step %d written to %s — exiting; "
+                "the relaunch resumes from it at any world size",
+                step, path,
+            )
+        except ValueError as ve:
+            # non-replicated state: a single survivor only holds one shard
+            self.logger.error(
+                "emergency checkpoint skipped: %s — the relaunch resumes "
+                "from the last durable checkpoint", ve,
+            )
+
     def _rollback(self, iter_generator, train_cfg):
         """N consecutive anomalous steps: restore the last checkpoint and
         rebuild the input stream from the restored iteration."""
@@ -607,6 +771,7 @@ class Runner:
             )
         self.iter = start_iter
         self.scheduler.last_epoch = start_iter
+        self._init_pipeline_position()
         self._consec_anomalies = 0
         self._gnorm_hist.clear()
         return self._make_stream()
@@ -617,8 +782,24 @@ class Runner:
             if self._watchdog:
                 self._watchdog.step_started(self.iter)
             self._apply_step_faults()
+            if self._elastic is not None:
+                # pre-step liveness gate: a peer that died BETWEEN steps is
+                # caught here, before this process enters any collective —
+                # the committed state is still saveable (emergency path)
+                self._elastic.check_peers()
             g_img, g_label = next(iter_generator)
-            self.train_iter(g_img, g_label)
+            if self._elastic is not None:
+                # elastic mode's documented per-step cost: the step runs
+                # under the peer-loss guard and is synced to completion, so
+                # a peer dying MID-collective turns an indefinite hang into
+                # a diagnosed PeerLostError within the heartbeat timeout
+                self._elastic.guard(
+                    self._synced_train_iter, g_img, g_label,
+                    what=f"train step {self.iter}",
+                )
+            else:
+                self.train_iter(g_img, g_label)
+            self._advance_pipeline()
             if self._watchdog:
                 self._watchdog.step_finished()
             if (
@@ -633,7 +814,9 @@ class Runner:
                     "%d and exiting",
                     self.iter,
                 )
-                self.checkpointer.save(self.iter, self.state)
+                self.checkpointer.save(
+                    self.iter, self.state, extras=self._pipeline_extras()
+                )
                 self.checkpointer.wait()
                 return
             if self.profiler:
@@ -656,7 +839,9 @@ class Runner:
             ):
                 if self.profiler:
                     self.profiler.stop(sync=self.state)
-                self.checkpointer.save(self.iter, self.state)
+                self.checkpointer.save(
+                    self.iter, self.state, extras=self._pipeline_extras()
+                )
                 if self.profiler:
                     # orbax saves are async — block until the write finishes
                     # so the window can't reopen over in-flight checkpoint I/O

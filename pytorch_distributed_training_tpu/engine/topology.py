@@ -27,7 +27,12 @@ from ..models import get_model
 from ..parallel import DATA_AXIS
 from ..parallel.sequence import SEQUENCE_AXIS
 
-__all__ = ["parse_topology", "parse_batch", "parse_fault_tolerance"]
+__all__ = [
+    "parse_topology",
+    "parse_batch",
+    "parse_fault_tolerance",
+    "parse_elastic",
+]
 
 
 def parse_topology(r, cfg: dict, train_cfg: dict, train_dataset) -> None:
@@ -475,3 +480,68 @@ def parse_fault_tolerance(r, train_cfg: dict) -> None:
 
     spec = ft.get("fault_spec")
     r.fault_spec = str(spec) if spec else None
+    if r.fault_spec:
+        # validate the spec HERE, at config-parse time: an unknown kind or
+        # malformed entry raises the descriptive ValueError immediately
+        # instead of silently never firing (engine/fault.py grammar)
+        from .fault import FaultInjector
+
+        FaultInjector(r.fault_spec)
+
+
+def parse_elastic(r, train_cfg: dict) -> None:
+    """Parse the additive ``training.elastic`` section (off by default) onto
+    the runner — the multi-host elastic-recovery layer (engine/elastic.py):
+
+    .. code-block:: yaml
+
+        training:
+            elastic:
+                enabled: true          # implied by a non-empty section
+                dir: null              # heartbeat dir (default:
+                                       #   <checkpoint.dir>/heartbeats)
+                heartbeat_interval: 0.5  # seconds between beats
+                timeout: 5.0           # peer presumed dead past this
+                startup_grace: null    # allowance for peers that have not
+                                       # written a first beat (default
+                                       # max(30, 4 x timeout))
+    """
+    el = train_cfg.get("elastic") or {}
+    unknown = set(el) - {
+        "enabled", "dir", "heartbeat_interval", "timeout", "startup_grace",
+    }
+    if unknown:
+        raise ValueError(
+            f"training.elastic: unknown key(s) {sorted(unknown)} "
+            "(want enabled/dir/heartbeat_interval/timeout/startup_grace)"
+        )
+    r.elastic_enabled = bool(el) and bool(el.get("enabled", True))
+    r.elastic_dir = el.get("dir")
+    r.elastic_heartbeat_interval = float(el.get("heartbeat_interval", 0.5))
+    r.elastic_timeout = float(el.get("timeout", 5.0))
+    r.elastic_startup_grace = (
+        float(el["startup_grace"]) if el.get("startup_grace") is not None
+        else None
+    )
+    if r.elastic_enabled:
+        if r.elastic_heartbeat_interval <= 0:
+            raise ValueError(
+                "training.elastic.heartbeat_interval must be > 0, got "
+                f"{r.elastic_heartbeat_interval}"
+            )
+        if r.elastic_timeout <= r.elastic_heartbeat_interval:
+            raise ValueError(
+                f"training.elastic.timeout ({r.elastic_timeout}) must exceed "
+                f"heartbeat_interval ({r.elastic_heartbeat_interval})"
+            )
+        ck = train_cfg.get("checkpoint") or {}
+        if not (r.elastic_dir or ck.get("dir")):
+            # without either dir there is nowhere to put heartbeats, and
+            # without a checkpoint the detected peer loss has nothing to
+            # save — the layer would detect and then lose the run anyway
+            raise ValueError(
+                "training.elastic requires training.checkpoint.dir (the "
+                "heartbeat dir defaults to <checkpoint.dir>/heartbeats and "
+                "peer loss triggers a checkpoint-and-exit), or an explicit "
+                "training.elastic.dir"
+            )

@@ -12,11 +12,13 @@ from .distributed import initialize_distributed, parse_dist_url
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    adapt_spec,
     batch_pspec,
     batch_sharding,
     make_mesh,
     make_3d_mesh,
     make_sp_mesh,
+    mesh_axis_sizes,
     replicated_sharding,
 )
 from .pipeline import (
@@ -40,6 +42,8 @@ __all__ = [
     "batch_sharding",
     "batch_pspec",
     "replicated_sharding",
+    "mesh_axis_sizes",
+    "adapt_spec",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQUENCE_AXIS",

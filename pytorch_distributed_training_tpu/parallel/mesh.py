@@ -26,6 +26,8 @@ __all__ = [
     "batch_sharding",
     "batch_pspec",
     "replicated_sharding",
+    "mesh_axis_sizes",
+    "adapt_spec",
 ]
 
 DATA_AXIS = "data"
@@ -122,3 +124,34 @@ def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated sharding (params / optimizer state in pure DP)."""
     return NamedSharding(mesh, P())
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict:
+    """``{axis_name: size}`` — the JSON-serializable mesh description the
+    elastic checkpoint metadata records, so a restore under a different
+    topology can log/validate exactly what reshape it is performing."""
+    return {str(name): int(size) for name, size in mesh.shape.items()}
+
+
+def adapt_spec(spec, mesh: Mesh) -> P:
+    """Re-derive a saved PartitionSpec against a *target* mesh.
+
+    ``spec`` is the saved leaf's partition spec as recorded in checkpoint
+    metadata (a sequence of axis-name / axis-name-tuple / None entries).
+    Axes the target mesh still has keep their placement; axes that
+    disappeared with the reshape (e.g. a stage axis on a run restarted
+    without pipeline parallelism) drop to replication on that dim —
+    the elastic-restore rule: the *target* topology's layout wins, and a
+    vanished mesh axis can only mean "this dim is no longer sharded".
+    """
+    names = set(mesh.axis_names)
+
+    def _one(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*(_one(e) for e in tuple(spec)))

@@ -89,7 +89,12 @@ def enable_compile_cache(directory: str) -> str:
     return directory
 
 
-def make_iter_dataloader(loader: Iterable, start_iter: int = 0) -> Generator[Tuple, None, None]:
+def make_iter_dataloader(
+    loader: Iterable,
+    start_iter: int = 0,
+    start_epoch: Optional[int] = None,
+    skip_batches: Optional[int] = None,
+) -> Generator[Tuple, None, None]:
     """Convert an epoch-based loader into an infinite per-iteration generator.
 
     Reference contract (train_distributed.py:27, :249-252): the training loop
@@ -106,6 +111,18 @@ def make_iter_dataloader(loader: Iterable, start_iter: int = 0) -> Generator[Tup
     host RNG (ImageFolder crop/flip) the skipped decodes don't consume RNG
     draws, so augmented pixels after resume differ from a hypothetical
     uninterrupted run — sample identity and visit order are still exact.
+
+    ``start_epoch``/``skip_batches`` (both or neither) OVERRIDE that
+    derivation with an explicitly persisted pipeline position (the elastic
+    checkpoint sidecar, engine/checkpoint.py): after a mesh reshape the
+    batch count per epoch may differ from the saving topology's, so
+    dividing the step counter by the *current* epoch length would land on
+    the wrong sample — the recorded (epoch, batches-consumed) pair is
+    topology-independent under ``batch_division: world``.
+
+    Validation runs eagerly at the CALL (this is a wrapper around the
+    actual generator), so a bad resume position fails where it was
+    computed, not at the loop's first ``next()``.
     """
     if hasattr(loader, "__len__") and len(loader) == 0:
         # drop_last can leave zero full batches (dataset shard < batch size);
@@ -114,16 +131,35 @@ def make_iter_dataloader(loader: Iterable, start_iter: int = 0) -> Generator[Tup
             "loader yields no batches (dataset shard smaller than batch size "
             "with drop_last?) — the iteration-based loop would spin forever"
         )
+    if (start_epoch is None) != (skip_batches is None):
+        raise ValueError(
+            "start_epoch and skip_batches must be given together "
+            f"(got start_epoch={start_epoch}, skip_batches={skip_batches})"
+        )
     epoch = 0
-    if start_iter:
+    if start_epoch is not None:
+        epoch = int(start_epoch)
+        skip = int(skip_batches)
+        if epoch < 0 or skip < 0:
+            raise ValueError(
+                f"start_epoch/skip_batches must be >= 0, got "
+                f"{start_epoch}/{skip_batches}"
+            )
+        if skip and hasattr(loader, "skip_next"):
+            loader.skip_next(skip)
+    elif start_iter:
         batches_per_epoch = len(loader)
         epoch = start_iter // batches_per_epoch
         skip = start_iter % batches_per_epoch
         if skip and hasattr(loader, "skip_next"):
             loader.skip_next(skip)
-    while True:
-        if hasattr(loader, "set_epoch"):
-            loader.set_epoch(epoch)
-        for batch in loader:
-            yield batch
-        epoch += 1
+
+    def _stream(epoch):
+        while True:
+            if hasattr(loader, "set_epoch"):
+                loader.set_epoch(epoch)
+            for batch in loader:
+                yield batch
+            epoch += 1
+
+    return _stream(epoch)

@@ -11,9 +11,12 @@ Design points:
   - exponential backoff ``backoff * 2**attempt`` capped at ``max_backoff``,
     with multiplicative jitter so a fleet of hosts retrying a shared
     filesystem doesn't stampede in lockstep,
-  - an exception *allowlist* (``retry_on``): only failures that can
-    plausibly be transient are retried — a ``ValueError`` from a
-    programming bug re-raises on the first attempt,
+  - an exception *allowlist* (``retry_on``) plus a *denylist*
+    (``non_retryable``): only failures that can plausibly be transient are
+    retried, and programming errors (``ValueError``/``TypeError`` by
+    default) re-raise on the first attempt even when an allowlisted base
+    class would otherwise catch them — retrying a deterministic bug only
+    burns the attempt budget and delays the traceback,
   - injectable ``sleep``/``rng`` so tests assert the exact delay sequence
     without waiting on a wall clock.
 """
@@ -38,6 +41,7 @@ class Retry:
         max_backoff: float = 8.0,
         jitter: float = 0.25,
         retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+        non_retryable: Tuple[Type[BaseException], ...] = (ValueError, TypeError),
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
         logger: Optional[logging.Logger] = None,
@@ -55,6 +59,7 @@ class Retry:
         self.max_backoff = float(max_backoff)
         self.jitter = float(jitter)
         self.retry_on = tuple(retry_on)
+        self.non_retryable = tuple(non_retryable)
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
         self._logger = logger
@@ -75,6 +80,10 @@ class Retry:
             try:
                 return fn(*args, **kwargs)
             except self.retry_on as exc:
+                if isinstance(exc, self.non_retryable):
+                    # deterministic failure (bad argument, wrong type):
+                    # retrying cannot help, surface it immediately
+                    raise
                 if attempt == self.attempts - 1:
                     raise
                 d = self.delay(attempt)

@@ -11,10 +11,23 @@ paths that single-process tests cannot reach.
 Driven by tests/test_multihost.py via environment variables:
   MH_RANK           process id (0-based)
   MH_NUM_NODES      number of processes ("hosts")
-  MH_PORT           coordinator port on 127.0.0.1
+  MH_PORT           coordinator port on 127.0.0.1 — or a comma-separated
+                    candidate list; rank 0 probes them in order (bounded,
+                    one attempt per candidate) and publishes the winner
+                    through MH_PORT_FILE, so a bind collision with another
+                    test run retries on the next candidate instead of dying
+  MH_PORT_FILE      rendezvous file for the chosen port (required when
+                    MH_PORT lists more than one candidate)
   MH_OUT            output JSON path (plus <MH_OUT>.npz for final params)
   MH_LOCAL_DEVICES  virtual CPU devices per process
   MH_BATCH_DIVISION training.batch_division value ("local" or "world")
+  MH_ELASTIC        "1" arms training.elastic (heartbeat peer-loss layer)
+  MH_HB_INTERVAL    elastic heartbeat interval seconds (default 0.1)
+  MH_HB_TIMEOUT     elastic peer timeout seconds (default 0.75)
+
+A diagnosed peer loss (engine.elastic.PeerLostError) is NOT a worker
+failure: the survivor writes its JSON with the diagnosis + recovery
+counters and exits 0 — the driving test asserts on that record.
 
 The platform must be pinned to CPU *before* mesh construction because a
 site-installed accelerator plugin may force ``jax_platforms`` to itself.
@@ -22,17 +35,77 @@ site-installed accelerator plugin may force ``jax_platforms`` to itself.
 import json
 import os
 import sys
+import time
 
 rank = int(os.environ["MH_RANK"])
 num_nodes = int(os.environ["MH_NUM_NODES"])
-port = os.environ["MH_PORT"]
 out_path = os.environ["MH_OUT"]
 local_devices = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
+
+
+def _choose_port(spec: str, rank: int) -> str:
+    """Resolve the coordinator port from a candidate list (see MH_PORT)."""
+    candidates = [c.strip() for c in spec.split(",") if c.strip()]
+    port_file = os.environ.get("MH_PORT_FILE")
+    if len(candidates) == 1 and not port_file:
+        return candidates[0]  # legacy single-port path, no rendezvous file
+    if not port_file:
+        raise RuntimeError(
+            "MH_PORT lists multiple candidates; set MH_PORT_FILE so "
+            "non-zero ranks can learn which one rank 0 bound"
+        )
+    if rank == 0:
+        import socket
+
+        last_err = None
+        for cand in candidates:  # bounded: one probe per candidate
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    s.bind(("127.0.0.1", int(cand)))
+                finally:
+                    s.close()
+            except OSError as e:
+                last_err = e
+                continue
+            tmp = port_file + ".tmp"
+            with open(tmp, "w") as fp:
+                fp.write(cand)
+            os.replace(tmp, port_file)  # atomic publish
+            return cand
+        raise RuntimeError(
+            f"no free coordinator port among {candidates}: {last_err}"
+        )
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        try:
+            with open(port_file) as fp:
+                text = fp.read().strip()
+        except OSError:
+            text = ""
+        if text:
+            return text
+        time.sleep(0.05)
+    raise RuntimeError(
+        f"rank {rank}: rank 0 never published a coordinator port to "
+        f"{port_file} within 30s"
+    )
+
+
+port = _choose_port(os.environ["MH_PORT"], rank)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     f"--xla_force_host_platform_device_count={local_devices}"
 )
+# Opt into the jax.shard_map compat graft (utils/jax_compat.py) BEFORE the
+# package import installs it: this worker is by definition a CPU test
+# harness on whatever JAX the dev image ships, and every assertion driven
+# through it compares runs of the SAME compiled program against each other
+# (multi-process vs single, interrupted vs oracle), so the pre-vma
+# autodiff caveat — consistent-but-different gradients on multi-device
+# meshes — cannot skew a verdict.  Inert on the grafted toolchain.
+os.environ.setdefault("PDT_JAX_COMPAT", "1")
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
@@ -44,7 +117,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
-from pytorch_distributed_training_tpu.engine import Runner  # noqa: E402
+from pytorch_distributed_training_tpu.engine import (  # noqa: E402
+    PeerLostError,
+    Runner,
+    fault,
+)
 
 
 class _RecordingTB:
@@ -125,6 +202,12 @@ def main():
         if ckpt_dir
         else {}
     )
+    if os.environ.get("MH_ELASTIC") == "1":
+        ckpt["elastic"] = {
+            "enabled": True,
+            "heartbeat_interval": float(os.environ.get("MH_HB_INTERVAL", "0.1")),
+            "timeout": float(os.environ.get("MH_HB_TIMEOUT", "0.75")),
+        }
     cfg = {
         "dataset": dataset,
         "training": {
@@ -163,7 +246,30 @@ def main():
         global_cfg=cfg,
         tb_writer_constructor=lambda: tb,
     )
-    runner()
+    try:
+        runner()
+    except PeerLostError as e:
+        # the DIAGNOSED dead-peer outcome the elastic layer promises: record
+        # it (plus the recovery counters — the emergency save already ran in
+        # runner._on_peer_lost) and exit 0.  os._exit skips interpreter
+        # teardown: jax.distributed shutdown barriers would hang against the
+        # very peer whose death was just diagnosed.
+        with open(out_path, "w") as fp:
+            json.dump(
+                {
+                    "rank": rank,
+                    "peer_lost": str(e),
+                    "dead_ranks": list(getattr(e, "dead_ranks", ())),
+                    "mid_step": bool(getattr(e, "mid_step", False)),
+                    "losses": runner.losses,
+                    "final_iter": runner.iter,
+                    "counters": fault.counters(),
+                },
+                fp,
+            )
+            fp.flush()
+            os.fsync(fp.fileno())
+        os._exit(0)
 
     params = jax.tree.leaves(jax.tree.map(np.asarray, runner.state.params))
     np.savez(out_path + ".npz", **{f"p{i}": p for i, p in enumerate(params)})
@@ -177,6 +283,7 @@ def main():
                 "losses": runner.losses,
                 "final_iter": runner.iter,
                 "eval": {t: v for t, v, _ in tb.scalars if t.startswith("eval/")},
+                "counters": fault.counters(),
                 "param_bytes_digest": __import__("hashlib").sha256(
                     b"".join(p.tobytes() for p in params)
                 ).hexdigest(),

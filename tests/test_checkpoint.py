@@ -111,6 +111,148 @@ def test_resume_bit_exact_vs_straight_run(tmp_path):
         np.testing.assert_array_equal(x, y)
 
 
+@pytest.fixture
+def one_device_graft(monkeypatch):
+    """``jax.shard_map`` compat-grafted for this test only, pinned to a
+    ONE-device mesh — collectives over a size-1 axis are identity, so the
+    pre-vma graft's autodiff caveat (utils/jax_compat.py) does not apply
+    and the real train step runs bit-deterministically on vanilla JAX."""
+    from pytorch_distributed_training_tpu.engine import paths
+    from pytorch_distributed_training_tpu.parallel import make_mesh
+
+    if not hasattr(jax, "shard_map"):
+        from pytorch_distributed_training_tpu.utils import jax_compat
+
+        monkeypatch.setenv("PDT_JAX_COMPAT", "1")
+        jax_compat.install()
+        wrapper = jax.shard_map
+        del jax.shard_map
+        monkeypatch.setattr(jax, "shard_map", wrapper, raising=False)
+    mesh = make_mesh(jax.devices()[:1])
+    monkeypatch.setattr(paths, "make_mesh", lambda *a, **kw: mesh)
+    return mesh
+
+
+class _BatchHashingRunner(Runner):
+    """Records a digest of every training batch the step consumes — the
+    observable the mid-epoch-resume contract is stated in."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.batch_hashes = []
+
+    def train_iter(self, g_img, g_label):
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.asarray(g_img).tobytes())
+        h.update(np.asarray(g_label).tobytes())
+        self.batch_hashes.append(h.hexdigest())
+        super().train_iter(g_img, g_label)
+
+
+def _run_hashing(cfg):
+    runner = _BatchHashingRunner(
+        num_nodes=1, rank=0, seed=3, dist_url="tcp://127.0.0.1:9903",
+        dist_backend="tpu", multiprocessing=False, logger_queue=None,
+        global_cfg=cfg, tb_writer_constructor=lambda: None,
+    )
+    runner()
+    return runner
+
+
+def test_mid_epoch_resume_batch_sequence_bit_exact(tmp_path, one_device_graft):
+    """Interrupt at iteration 2 of a 4-batch epoch and resume: the resumed
+    run must consume EXACTLY the batches (bitwise) the uninterrupted run
+    would have — pinned on the batch digests, not just the final params —
+    and the checkpoint must carry the (epoch, batch_in_epoch) sidecar the
+    resume used."""
+    import json as _json
+    import os
+
+    straight = _run_hashing(_cfg(tmp_path / "a", ckpt=False, train_iters=6))
+    assert len(straight.batch_hashes) == 6  # 64 samples/16 = 4 per epoch
+
+    cfg_b = _cfg(tmp_path / "b", train_iters=2)
+    first = _run_hashing(cfg_b)
+    assert first.batch_hashes == straight.batch_hashes[:2]
+
+    # the interval-2 save at step 1 wrote the pipeline sidecar: 2 batches
+    # of epoch 0 consumed — a MID-epoch position
+    sidecar = os.path.join(str(tmp_path / "b" / "ckpt"), "pipeline_1.json")
+    assert os.path.exists(sidecar), "pipeline sidecar missing"
+    with open(sidecar) as fp:
+        extras = _json.load(fp)
+    assert extras["epoch"] == 0 and extras["batch_in_epoch"] == 2
+    assert extras["batches_per_epoch"] == 4
+
+    resumed = _run_hashing(_cfg(tmp_path / "b", train_iters=6))
+    assert resumed.iter == 6
+    # the resumed stream picked up at epoch 0, batch 2 — bit-identical
+    assert resumed.batch_hashes == straight.batch_hashes[2:]
+
+
+def test_emergency_checkpoint_roundtrip_and_precedence(tmp_path):
+    """save_emergency/restore_latest: a survivor's local dump of fully-
+    replicated state restores exactly (values + extras), is preferred over
+    OLDER orbax steps, and yields to NEWER ones; non-replicated state is
+    rejected with a diagnosis instead of silently saving one shard."""
+    from pytorch_distributed_training_tpu.engine import TrainState, fault
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import replicated_sharding
+    from pytorch_distributed_training_tpu.parallel.mesh import make_mesh
+
+    opt = SGD(lr=0.1, momentum=0.9)
+    mesh = make_mesh()
+
+    def make_state(fill):
+        params = {"w": jnp.full((8, 4), float(fill)), "b": jnp.full((4,), float(fill))}
+        state = TrainState(
+            params=params, batch_stats={}, opt_state=opt.init(params)
+        )
+        return jax.device_put(state, replicated_sharding(mesh))
+
+    fault.reset_counters()
+    ck = Checkpointer(str(tmp_path / "c"), interval=1)
+    ck.save(3, make_state(3.0))
+    ck.wait()
+
+    extras = {"epoch": 1, "batch_in_epoch": 2, "batches_per_epoch": 4}
+    ck.save_emergency(4, make_state(4.0), extras=extras)
+    assert ck.latest_emergency() == 4
+    assert ck.read_extras(4)["batch_in_epoch"] == 2
+
+    # newer than orbax step 3: the emergency dump wins
+    restored, next_iter = ck.restore_latest(make_state(0.0))
+    assert next_iter == 5
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]), np.full((8, 4), 4.0)
+    )
+    assert fault.counters().get("elastic_restores") == 1
+
+    # an orbax step NEWER than the emergency takes precedence again
+    ck.save(9, make_state(9.0))
+    ck.wait()
+    restored2, next_iter2 = ck.restore_latest(make_state(0.0))
+    assert next_iter2 == 10
+    np.testing.assert_array_equal(
+        np.asarray(restored2.params["w"]), np.full((8, 4), 9.0)
+    )
+
+    # sharded (non-replicated) state: a lone survivor holds one shard only
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharded = jax.device_put(
+        jnp.arange(32.0).reshape(8, 4), NamedSharding(mesh, P("data"))
+    )
+    bad = TrainState(
+        params={"w": sharded}, batch_stats={}, opt_state=opt.init({"w": sharded})
+    )
+    with pytest.raises(ValueError, match="survivor"):
+        ck.save_emergency(11, bad)
+    ck.close()
+
+
 def test_preemption_guard_restores_handlers():
     import signal
 

@@ -139,6 +139,53 @@ def test_make_iter_dataloader_advances_epochs():
     )
 
 
+def test_skip_next_rejects_negative_and_clamps_past_epoch_end():
+    ds = SyntheticDataset(n_samples=32, n_classes=4, image_size=4)
+    s = SequentialSampler(len(ds))
+    loader = DataLoader(ds, batch_size=8, sampler=s, drop_last=True)
+    assert len(loader) == 4
+
+    with pytest.raises(ValueError, match="got -1"):
+        loader.skip_next(-1)
+
+    # skip within the epoch: exactly the tail batches remain
+    full = [label.copy() for _, label in loader]
+    loader.skip_next(3)
+    tail = [label.copy() for _, label in loader]
+    assert len(tail) == 1
+    np.testing.assert_array_equal(tail[0], full[3])
+
+    # skip past the end is CLAMPED: the next iteration yields nothing (the
+    # epoch-boundary resume case), and the one after is back to full length
+    loader.skip_next(99)
+    assert list(loader) == []
+    assert len(list(loader)) == 4  # skip is one-shot, not sticky
+
+
+def test_make_iter_dataloader_explicit_position_overrides_derivation():
+    """The elastic-resume entry point: (start_epoch, skip_batches) places
+    the stream independently of start_iter — required after a mesh reshape
+    where the step counter divided by the CURRENT epoch length would land
+    on the wrong sample."""
+    ds = SyntheticDataset(n_samples=16, n_classes=2, image_size=4)
+
+    def fresh():
+        s = RandomSampler(len(ds), seed=5)
+        return DataLoader(ds, batch_size=4, sampler=s, drop_last=True)
+
+    straight = make_iter_dataloader(fresh())
+    want = [next(straight)[1] for _ in range(7)]  # epoch 0 (4) + epoch 1 (3)
+
+    resumed = make_iter_dataloader(fresh(), start_epoch=1, skip_batches=2)
+    got = [next(resumed)[1] for _ in range(1)]
+    np.testing.assert_array_equal(got[0], want[6])  # epoch 1, batch 2
+
+    with pytest.raises(ValueError, match="together"):
+        make_iter_dataloader(fresh(), start_epoch=1)
+    with pytest.raises(ValueError, match=">= 0"):
+        make_iter_dataloader(fresh(), start_epoch=-1, skip_batches=0)
+
+
 def test_get_dataset_factory():
     ds = get_dataset("synthetic", "/nonexistent", "train", n_classes=7, image_size=16, n_samples=32)
     assert len(ds) == 32

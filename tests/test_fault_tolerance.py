@@ -129,6 +129,45 @@ def test_retry_allowlist_and_exhaustion():
     assert calls["n"] == 3
 
 
+def test_retry_non_retryable_classification():
+    """Programming errors raise immediately even when the allowlist would
+    catch them: ValueError/TypeError are deterministic — retrying burns
+    the attempt budget and delays the traceback."""
+    policy = Retry(
+        attempts=3, backoff=0.0, jitter=0.0, sleep=lambda d: None,
+        retry_on=(Exception,),  # broad allowlist that COVERS ValueError
+    )
+    calls = {"n": 0}
+
+    def bad_argument():
+        calls["n"] += 1
+        raise ValueError("bad argument")
+
+    with pytest.raises(ValueError, match="bad argument"):
+        policy.call(bad_argument)
+    assert calls["n"] == 1  # no retry: classified non-retryable
+
+    calls["n"] = 0
+
+    def wrong_type():
+        calls["n"] += 1
+        raise TypeError("wrong type")
+
+    with pytest.raises(TypeError):
+        policy.call(wrong_type)
+    assert calls["n"] == 1
+
+    # the denylist is a parameter: opting out restores plain allowlisting
+    permissive = Retry(
+        attempts=3, backoff=0.0, jitter=0.0, sleep=lambda d: None,
+        retry_on=(ValueError,), non_retryable=(),
+    )
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        permissive.call(bad_argument)
+    assert calls["n"] == 3  # retried to exhaustion
+
+
 # ======================================================================
 # engine/fault.py — spec grammar and injector semantics
 # ======================================================================
@@ -168,6 +207,47 @@ def test_fault_spec_parsing_and_one_shot():
 def test_fault_spec_errors(spec):
     with pytest.raises(ValueError):
         FaultInjector(spec)
+
+
+def test_unknown_fault_kind_names_the_valid_kinds():
+    """A typo'd kind must fail at SPEC-PARSE time with the full menu, not
+    deep into the run when the fault would have fired."""
+    with pytest.raises(ValueError) as ei:
+        FaultInjector("kil_peer@3")
+    msg = str(ei.value)
+    for kind in ("nan_batch", "kill_worker", "stall_step", "kill_peer",
+                 "ckpt_fail", "restore_fail"):
+        assert kind in msg, f"{kind!r} missing from the error menu: {msg}"
+
+
+def test_kill_peer_spec_parses_with_optional_rank():
+    inj = FaultInjector("kill_peer@5")
+    assert inj.take("kill_peer", 5) == -1.0  # default: any rank
+    inj = FaultInjector("kill_peer@7:1")
+    assert inj.take("kill_peer", 7) == 1.0
+    assert inj.take("kill_peer", 7) is None  # one-shot
+
+
+def test_fault_spec_config_key_validated_at_parse_time():
+    """A bad training.fault_tolerance.fault_spec fails when the CONFIG is
+    parsed (topology.parse_fault_tolerance constructs an injector eagerly),
+    not minutes later when the injector is first consulted."""
+    import types
+
+    from pytorch_distributed_training_tpu.engine.topology import (
+        parse_fault_tolerance,
+    )
+
+    with pytest.raises(ValueError, match="unknown kind"):
+        parse_fault_tolerance(
+            types.SimpleNamespace(),
+            {"fault_tolerance": {"fault_spec": "bogus@1"}},
+        )
+    r = types.SimpleNamespace()
+    parse_fault_tolerance(
+        r, {"fault_tolerance": {"fault_spec": "kill_peer@5; nan_batch@2"}}
+    )
+    assert r.fault_spec == "kill_peer@5; nan_batch@2"
 
 
 # ======================================================================

@@ -45,6 +45,10 @@ _FAULT_MACHINERY = (
     "PDT_FAULT_SPEC",
     "StepWatchdog",
     "ProcessLoaderPool",
+    "ElasticCoordinator",
+    "kill_peer",
+    "multihost_worker",
+    "MH_ELASTIC",
 )
 _HEAVY_INDICATORS = ("time.sleep(", "os.kill(", "Process(", "subprocess")
 

@@ -41,6 +41,19 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _free_ports(n: int) -> list:
+    """``n`` DISTINCT free ports (bound simultaneously so the kernel can't
+    hand the same one back) — the worker's bounded bind-retry candidates."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
 def _clean_env() -> dict:
     env = dict(os.environ)
     # the workers pin their own platform/device-count flags
@@ -50,7 +63,7 @@ def _clean_env() -> dict:
 
 
 def _launch(rank, num_nodes, port, out, local_devices, division="world",
-            task="image", seq_par=1):
+            task="image", seq_par=1, extra_env=None):
     env = _clean_env()
     env.update(
         MH_RANK=str(rank),
@@ -62,6 +75,8 @@ def _launch(rank, num_nodes, port, out, local_devices, division="world",
         MH_TASK=task,
         MH_SEQ_PAR=str(seq_par),
     )
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
     # log to a FILE, not a pipe: ranks are waited on sequentially, and an
     # unread sibling pipe filling the OS buffer would block that rank
     # mid-collective and deadlock the whole topology until the timeout
@@ -77,6 +92,21 @@ def _launch(rank, num_nodes, port, out, local_devices, division="world",
     return proc
 
 
+# The unambiguous signature of a JAX build whose CPU backend has no
+# cross-process collectives at all (pre-graft jax<=0.4.x): every
+# multi-process topology is unrunnable on it, which is a platform limit,
+# not a regression — the affected tests SKIP instead of failing.
+_NO_MULTIPROC_CPU = "Multiprocess computations aren't implemented"
+
+
+def _skip_if_unsupported(log):
+    if _NO_MULTIPROC_CPU in log:
+        pytest.skip(
+            "this JAX's CPU backend cannot run multi-process computations "
+            "(needs the grafted toolchain or a real accelerator)"
+        )
+
+
 def _wait(proc, what, timeout=900):
     try:
         proc.wait(timeout=timeout)
@@ -86,16 +116,23 @@ def _wait(proc, what, timeout=900):
     proc._log_file.close()
     with open(proc._log_file.name) as fp:
         out = fp.read()
+    if proc.returncode != 0:
+        _skip_if_unsupported(out)
     assert proc.returncode == 0, f"{what} failed (rc={proc.returncode}):\n{out}"
 
 
 def _run_topology_once(tmp_path, tag, n_procs, local_devices, division,
-                       task="image", seq_par=1):
-    port = _free_port()
+                       task="image", seq_par=1, extra_env=None):
+    # hand the workers CANDIDATE ports: rank 0 probes them in order and
+    # publishes the first it can bind (multihost_worker._choose_port), so a
+    # port stolen in the probe/rebind window costs a retry, not the test
+    port = ",".join(str(p) for p in _free_ports(3))
+    env = dict(extra_env or {})
+    env["MH_PORT_FILE"] = str(tmp_path / f"{tag}.port")
     outs = [str(tmp_path / f"{tag}_rank{r}.json") for r in range(n_procs)]
     procs = [
         _launch(r, n_procs, port, outs[r], local_devices, division,
-                task=task, seq_par=seq_par)
+                task=task, seq_par=seq_par, extra_env=env)
         for r in range(n_procs)
     ]
     try:
@@ -111,21 +148,21 @@ def _run_topology_once(tmp_path, tag, n_procs, local_devices, division,
 
 
 def _run_topology(tmp_path, tag, n_procs, local_devices, division="world",
-                  task="image", seq_par=1):
+                  task="image", seq_par=1, extra_env=None):
     try:
         outs = _run_topology_once(tmp_path, tag, n_procs, local_devices,
-                                  division, task, seq_par)
+                                  division, task, seq_par, extra_env)
     except AssertionError as e:
-        # _free_port releases the probe socket before the workers rebind it —
-        # another process can steal the port in that window; retry once on a
-        # fresh port before declaring failure
+        # the worker's candidate-port probing absorbs most collisions, but
+        # all candidates can in principle be stolen between the probe and
+        # rank 0's rebind; retry once on fresh ports before declaring failure
         if "Failed to bind" not in str(e) and "address already in use" not in str(
             e
         ).lower():
             raise
         outs = _run_topology_once(
             tmp_path, tag + "_retry", n_procs, local_devices, division,
-            task, seq_par
+            task, seq_par, extra_env
         )
     results = []
     for o in outs:
@@ -205,3 +242,30 @@ def test_two_process_lm_ring_sp(tmp_path):
     assert r0["param_bytes_digest"] == r1["param_bytes_digest"]
     np.testing.assert_allclose(r0["losses"][:2], one[0]["losses"][:2], rtol=1e-4)
     np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=2e-2)
+
+
+@pytest.mark.slow
+def test_reshape_restore_two_process_to_one(tmp_path):
+    """Mesh-reshape-tolerant restore: a checkpoint written under mesh shape
+    A (2 processes, dp=2x4) restores under shape B (1 process, dp=1x8) —
+    the restore path builds abstract leaves with the TARGET topology's
+    shardings, so the saved partition layout never constrains the new mesh.
+    The relaunch sees start_iter == train_iters, runs zero steps, and its
+    dumped params must equal the 2-process run's final params exactly."""
+    ckpt = tmp_path / "ckpt"
+    saved = _run_topology(
+        tmp_path, "reshape_save", n_procs=2, local_devices=4, task="lm",
+        extra_env={"MH_CKPT_DIR": ckpt, "MH_TRAIN_ITERS": 4},
+    )
+    restored = _run_topology(
+        tmp_path, "reshape_load", n_procs=1, local_devices=8, task="lm",
+        extra_env={"MH_CKPT_DIR": ckpt, "MH_TRAIN_ITERS": 4},
+    )
+    # the final-iteration save (step 3) was picked up: no steps re-run
+    assert restored[0]["final_iter"] == 4
+    assert restored[0]["losses"] == []
+    # restore across the reshape is value-exact (same bytes, new placement)
+    for key in saved[0]["params"].files:
+        np.testing.assert_array_equal(
+            saved[0]["params"][key], restored[0]["params"][key], err_msg=key
+        )
