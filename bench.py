@@ -21,6 +21,11 @@ Additional modes (VERDICT round-1 item #1 — prove host-side throughput):
                              ce_softmax / optimizer / host_infeed buckets
                              that partition step_ms exactly (one JSON line;
                              BENCH_DECOMP_OUT=path also writes it to disk).
+  python bench.py ckpt     — checkpoint save-stall A/B: short LM run with
+                             periodic saves, synchronous vs async
+                             (training.checkpoint.async) — save-step stall,
+                             bytes written, overlap efficiency, plus a
+                             kill-during-async-write restore probe.
 
 Precision: bf16 compute with fp32 master weights and fp32 BN statistics —
 the TPU-native mixed-precision mode (BASELINE.json config #4); set
@@ -819,6 +824,204 @@ def bench_serve():
     )
 
 
+def bench_ckpt():
+    """Checkpoint-overlap mode: sync vs async save stall on a short LM run.
+
+    Trains a small TransformerLM (test-sync-sized; CPU-friendly shapes) with
+    periodic saves twice — once with the synchronous save path, once with
+    ``checkpoint.async`` — timing every step.  One JSON line:
+
+      nonsave_step_ms      median step with no save in it
+      sync/async_save_step_ms  median step that includes a ``save`` call
+      sync/async_stall_ms  save-step time minus the non-save median — the
+                           part checkpointing adds to the critical path
+      bytes_written        one phase's checkpoint dir, walked
+      overlap_efficiency   1 - async_stall/sync_stall (1.0 = fully hidden)
+      chaos_*              kill-during-async-write probe: the LAST save's
+                           background write is failed past its retry budget
+                           (``ckpt_async_fail``), the step stays uncommitted,
+                           and restore_latest must hand back the previous
+                           committed step
+
+    The acceptance bar (ISSUE 5): async stall <= 1.1x a non-save step —
+    the save step pays only the device->host snapshot — while sync stall
+    shows the full serialize+write.
+
+      BENCH_CKPT_ITERS     steps per phase (default 24)
+      BENCH_CKPT_INTERVAL  save every N steps (default 6)
+      BENCH_CKPT_VOCAB/SEQ/EMBED/DEPTH/HEADS/BATCH  LM shapes
+    """
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import (
+        TrainState,
+        build_lm_train_step,
+        fault,
+    )
+    from pytorch_distributed_training_tpu.engine.checkpoint import Checkpointer
+    from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+    from pytorch_distributed_training_tpu.optimizers import AdamW
+    from pytorch_distributed_training_tpu.parallel import (
+        make_sp_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import cosine_lr
+    from pytorch_distributed_training_tpu.utils.retry import Retry
+
+    iters = int(os.environ.get("BENCH_CKPT_ITERS", "24"))
+    interval = int(os.environ.get("BENCH_CKPT_INTERVAL", "6"))
+    vocab = int(os.environ.get("BENCH_CKPT_VOCAB", "8192"))
+    seq = int(os.environ.get("BENCH_CKPT_SEQ", "128"))
+    embed = int(os.environ.get("BENCH_CKPT_EMBED", "256"))
+    depth = int(os.environ.get("BENCH_CKPT_DEPTH", "2"))
+    heads = int(os.environ.get("BENCH_CKPT_HEADS", "4"))
+    batch = int(os.environ.get("BENCH_CKPT_BATCH", "8"))
+
+    mesh = make_sp_mesh(sequence_parallelism=1)
+    lm = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=embed, depth=depth,
+        num_heads=heads, dtype=jnp.bfloat16,
+    )
+    # AdamW, not SGD: two moment trees triple the saved state — the write
+    # the async path must hide is the realistic (optimizer-heavy) one
+    opt = AdamW(lr=3e-4, weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :seq]))["params"]
+    state0 = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state0 = jax.device_put(state0, replicated_sharding(mesh))
+    step = build_lm_train_step(lm, opt, cosine_lr(3e-4, 100000), mesh)
+    inp = jax.device_put(jnp.asarray(tokens[:, :-1]), replicated_sharding(mesh))
+    lab = jax.device_put(jnp.asarray(tokens[:, 1:]), replicated_sharding(mesh))
+
+    # the compiled step donates the incoming state's buffers, so every
+    # consumer (warmup, each phase, the chaos probe) needs fresh device
+    # buffers — keep one host copy and re-put per use
+    state_host = jax.device_get(state0)
+    del state0
+
+    def fresh_state():
+        return jax.device_put(state_host, replicated_sharding(mesh))
+
+    warm = fresh_state()
+    for _ in range(3):
+        warm, loss = step(warm, inp, lab)
+    float(loss)
+
+    def dir_bytes(root):
+        total = 0
+        for base, _dirs, files in os.walk(root):
+            for f in files:
+                try:
+                    total += os.path.getsize(os.path.join(base, f))
+                except OSError:
+                    pass
+        return total
+
+    def run_phase(tmp, async_save):
+        ck = Checkpointer(
+            os.path.join(tmp, "ckpt"), interval=interval, max_to_keep=3,
+            async_save=async_save,
+        )
+        state = fresh_state()
+        nonsave, save_steps = [], []
+        try:
+            for it in range(iters):
+                t0 = time.perf_counter()
+                state, loss = step(state, inp, lab)
+                # per-step host sync (same rationale as bench_lm): the timed
+                # window must contain the step AND, on save steps, only the
+                # part of the save that blocks this thread
+                float(loss)
+                if ck.should_save(it, iters):
+                    ck.save(it, state, extras={"bench_iter": it})
+                    save_steps.append(time.perf_counter() - t0)
+                else:
+                    nonsave.append(time.perf_counter() - t0)
+            ck.wait()
+        finally:
+            ck.close()
+        return (
+            statistics.median(nonsave) * 1e3,
+            statistics.median(save_steps) * 1e3,
+            dir_bytes(os.path.join(tmp, "ckpt")),
+        )
+
+    with tempfile.TemporaryDirectory(prefix="bench_ckpt_") as tmp_s, \
+            tempfile.TemporaryDirectory(prefix="bench_ckpt_") as tmp_a:
+        sync_nonsave, sync_save, nbytes = run_phase(tmp_s, async_save=False)
+        async_nonsave, async_save_ms, _ = run_phase(tmp_a, async_save=True)
+
+        # ---- kill-during-async-write probe (the chaos acceptance leg) ----
+        fault.reset_counters()
+        chaos_dir = os.path.join(tmp_a, "chaos_ckpt")
+        ck = Checkpointer(
+            chaos_dir, interval=1, max_to_keep=3, async_save=True,
+            retry=Retry(attempts=2, backoff=0.01, logger=None),
+        )
+        state = fresh_state()
+        state, loss = step(state, inp, lab)
+        float(loss)
+        ck.save(0, state)
+        ck.wait()  # step 0 durably committed
+        fault.install("ckpt_async_fail@0:99")  # every later attempt dies
+        try:
+            state, loss = step(state, inp, lab)
+            float(loss)
+            ck.save(1, state)  # background write fails past the retry budget
+            ck.drain(raise_errors=False)
+            steps_after = ck.all_steps()
+            _restored, resume_iter = ck.restore_latest(fresh_state())
+        finally:
+            ck.close()
+            fault.install(None)
+        counters = fault.counters()
+
+    nonsave_ms = statistics.median([sync_nonsave, async_nonsave])
+    sync_stall = max(sync_save - sync_nonsave, 0.0)
+    async_stall = max(async_save_ms - async_nonsave, 0.0)
+    overlap = 1.0 - async_stall / sync_stall if sync_stall > 0 else None
+    print(
+        json.dumps(
+            {
+                "metric": f"async ckpt save-step stall (LM "
+                f"{sum(x.size for x in jax.tree_util.tree_leaves(params)) / 1e6:.0f}M"
+                f"+AdamW, save every {interval} steps)",
+                "value": round(async_stall, 1),
+                "unit": "ms",
+                # smaller is better; 0 = the write is fully off the
+                # critical path, 1.0 = no better than the sync save
+                "vs_baseline": (
+                    round(async_stall / sync_stall, 3) if sync_stall > 0 else None
+                ),
+                "baseline": "same run with synchronous saves",
+                "nonsave_step_ms": round(nonsave_ms, 1),
+                "sync_save_step_ms": round(sync_save, 1),
+                "async_save_step_ms": round(async_save_ms, 1),
+                "sync_stall_ms": round(sync_stall, 1),
+                "async_stall_ms": round(async_stall, 1),
+                "bytes_written": nbytes,
+                "overlap_efficiency": (
+                    round(overlap, 3) if overlap is not None else None
+                ),
+                "async_stall_vs_step": (
+                    round((async_save_ms / async_nonsave), 3)
+                    if async_nonsave > 0 else None
+                ),
+                "chaos_uncommitted_step_dropped": steps_after == [0],
+                "chaos_resume_iter": resume_iter,
+                **{f"chaos_{k}": v for k, v in counters.items()
+                   if "ckpt" in k or "inject" in k},
+            }
+        )
+    )
+
+
 def bench_chaos():
     """Chaos mode: the smoke run under a standard fault script, end to end.
 
@@ -831,6 +1034,10 @@ def bench_chaos():
 
       PDT_FAULT_SPEC   override the fault script (engine/fault.py grammar)
       BENCH_CHAOS_ITERS  train_iters (default 12)
+      BENCH_CHAOS_ASYNC=0  synchronous saves + the ckpt_fail point instead
+                       of async overlap + ckpt_async_fail (the default
+                       kills the BACKGROUND writer's attempts, proving the
+                       retry/rollback layers compose with overlapped saves)
       BENCH_CHAOS_MULTIHOST=0  skip the 2-process kill-peer scenario
     """
     import tempfile
@@ -838,12 +1045,15 @@ def bench_chaos():
     from pytorch_distributed_training_tpu.engine import Runner, fault
 
     iters = int(os.environ.get("BENCH_CHAOS_ITERS", "12"))
+    use_async = os.environ.get("BENCH_CHAOS_ASYNC", "1") != "0"
     spec = os.environ.get(fault.ENV_VAR) or (
         # one skip at 2; burst 5-7 trips max_consecutive=3 -> rollback to the
-        # step-5 save; save attempts 0+1 fail -> retried; worker 0 killed at
-        # 4 -> respawned; 1.0s stall at 8 -> watchdog (limit 0.5s) fires
+        # step-5 save; save attempts 0+1 fail -> retried (on the background
+        # writer thread in the default async mode); worker 0 killed at 4 ->
+        # respawned; 1.0s stall at 8 -> watchdog (limit 0.5s) fires
         "nan_batch@2;nan_batch@5;nan_batch@6;nan_batch@7;"
-        "ckpt_fail@0:2;kill_worker@4:0;stall_step@8:1.0"
+        f"{'ckpt_async_fail' if use_async else 'ckpt_fail'}@0:2;"
+        "kill_worker@4:0;stall_step@8:1.0"
     )
     with tempfile.TemporaryDirectory(prefix="chaos_") as tmp:
         cfg = {
@@ -869,6 +1079,7 @@ def bench_chaos():
                 "checkpoint": {
                     "dir": os.path.join(tmp, "ckpt"), "interval": 3,
                     "resume": True, "retry": {"backoff": 0.05},
+                    "async": use_async, "max_inflight": 1,
                 },
                 "fault_tolerance": {
                     "anomaly": {"enabled": True, "max_consecutive": 3},
@@ -1089,6 +1300,8 @@ if __name__ == "__main__":
         bench_decompose()
     elif mode == "flash":
         bench_flash()
+    elif mode == "ckpt":
+        bench_ckpt()
     elif mode in ("serve", "--serve"):
         bench_serve()
     elif mode in ("chaos", "--chaos"):

@@ -14,6 +14,24 @@ reference exactly:
             dir: run/ckpt        # required to enable
             interval: 1000       # save every N iterations (default 1000)
             resume: True         # restore latest on startup (default True)
+            async: False         # overlap save I/O with compute (below)
+            max_inflight: 1      # async only: bound on queued writes
+
+Async saves (``async: true``): the save step blocks only for the
+device→host snapshot of the state; serialization and the filesystem write
+happen on a single background writer thread while training continues.  The
+*commit barrier* is every later synchronization point — the next ``save``,
+``wait``, ``drain`` or ``close`` — where a background write that exhausted
+its retry budget re-raises (as :class:`AsyncCheckpointError`, chaining the
+original failure).  The sidecar is written strictly AFTER the orbax commit
+in both modes, so a sidecar never advertises a checkpoint that doesn't
+durably exist, and a crash mid-write leaves only an uncommitted
+``<step>.orbax-checkpoint-tmp-*`` directory that ``restore_latest`` never
+sees (the atomic-rename commit is orbax's, unchanged).  orbax's own
+internal async machinery is disabled (``enable_async_checkpointing=False``)
+so this layer owns the asynchrony end to end: sync mode really blocks for
+the full write (the bench A/B is honest) and async-mode write errors flow
+through ``utils.retry.Retry`` instead of orbax's detached future.
 
 Saved payload: the full replicated ``TrainState`` (params, BN running stats,
 optimizer momentum + step) — everything needed to resume bit-exact (the
@@ -47,12 +65,16 @@ import glob
 import json
 import logging
 import os
+import queue
 import re
-from typing import Any, Optional, Tuple
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 
-__all__ = ["Checkpointer", "load_serving_state"]
+__all__ = ["AsyncCheckpointError", "Checkpointer", "load_serving_state"]
 
 # The layout-vs-corruption discrimination in ``_structure_differs`` relies
 # on an orbax contract that is conventional, not documented API: that
@@ -95,6 +117,72 @@ def _orbax_metadata_contract_ok(logger: Optional[logging.Logger] = None) -> bool
     return ok
 
 
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint write failed after exhausting its retries.
+
+    Raised at the NEXT synchronization point (``save``/``wait``/``drain``)
+    after the failure, never inside the training step that enqueued the
+    write — the deferred-error contract of async checkpointing.  The
+    original storage error is chained as ``__cause__``.
+    """
+
+
+class _Pending:
+    """One enqueued background write: completion event + captured error."""
+
+    def __init__(self, fn: Callable[[], None]):
+        self._fn = fn
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def run(self) -> None:
+        try:
+            self._fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced at a sync point
+            self.error = e
+        finally:
+            self._done.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class _AsyncWriter:
+    """A single daemon thread draining a FIFO of checkpoint writes.
+
+    One thread, not a pool: orbax's ``CheckpointManager`` is not safe for
+    concurrent ``save`` calls, so however large ``max_inflight`` is, writes
+    are strictly serialized here and the inflight bound only limits queue
+    depth.  The thread is a *daemon* (unlike ``ThreadPoolExecutor``'s
+    workers, whose atexit join would wedge the crash-path process exit —
+    peer death, watchdog abort — behind a write stuck in a dead
+    collective filesystem operation).
+    """
+
+    def __init__(self):
+        self._queue: "queue.SimpleQueue[Optional[_Pending]]" = queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._loop, name="ckpt-async-writer", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], None]) -> _Pending:
+        pending = _Pending(fn)
+        self._queue.put(pending)
+        return pending
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        self._queue.put(None)
+        self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while True:
+            pending = self._queue.get()
+            if pending is None:
+                return
+            pending.run()
+
+
 class Checkpointer:
     """Thin orbax CheckpointManager wrapper keyed by iteration.
 
@@ -105,24 +193,60 @@ class Checkpointer:
     unreadable after retries is *skipped with a warning* and the newest
     earlier step is tried (``restore_latest``'s fallback loop), so one
     corrupt/truncated step directory cannot strand a resumable run.
+
+    Async overlap (additive, ``training.checkpoint.async``): ``save``
+    blocks only for the host snapshot and the write happens on a daemon
+    writer thread; see the module docstring for the commit-barrier
+    semantics.  A crash mid-async-write leaves the step uncommitted
+    (orbax's tmp-dir rename never happened), so ``restore_latest`` treats
+    it exactly like the truncated-checkpoint case: the step is invisible
+    and the previous committed step restores.
     """
 
     def __init__(self, directory: str, interval: int = 1000, max_to_keep: int = 3,
-                 retry: Optional["Retry"] = None):
+                 retry: Optional["Retry"] = None, async_save: bool = False,
+                 max_inflight: int = 1):
         import orbax.checkpoint as ocp
 
         from ..utils.retry import Retry
 
+        if int(max_inflight) < 1:
+            raise ValueError(
+                f"checkpoint.max_inflight must be >= 1, got {max_inflight}"
+            )
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self.interval = int(interval)
+        self.max_to_keep = int(max_to_keep)
+        self.async_save = bool(async_save)
+        self.max_inflight = int(max_inflight)
         self.retry = retry if retry is not None else Retry(
             logger=logging.getLogger(__name__)
         )
         self.retries = 0  # retried save/restore attempts (observability)
+        # async machinery: a lazily started writer thread, the FIFO of
+        # in-flight (step, pending) writes, and errors deferred to the next
+        # synchronization point (module docstring: the commit barrier)
+        self._writer: Optional[_AsyncWriter] = None
+        self._inflight: "deque[Tuple[int, _Pending]]" = deque()
+        self._deferred: List[Tuple[int, BaseException]] = []
+        self._known_steps: set = set()  # committed steps (sidecar GC diff)
+        self._async_fallback_warned = False
         self._manager = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                # this layer owns the asynchrony (module docstring): sync
+                # mode must truly block, async errors must flow through the
+                # retry policy, and the sidecar must follow the commit
+                enable_async_checkpointing=False,
+            ),
         )
+        # seed with the steps already on disk so a resumed process prunes
+        # sidecars of checkpoints its own saves push out of max_to_keep
+        try:
+            self._known_steps.update(self._manager.all_steps())
+        except Exception:
+            pass  # unreadable dir surfaces at first save/restore instead
 
     @classmethod
     def from_config(cls, train_cfg: dict) -> Optional["Checkpointer"]:
@@ -146,7 +270,11 @@ class Checkpointer:
             logger=logging.getLogger(__name__),
         )
         return cls(ck["dir"], interval=ck.get("interval", 1000),
-                   max_to_keep=ck.get("max_to_keep", 3), retry=retry)
+                   max_to_keep=ck.get("max_to_keep", 3), retry=retry,
+                   # "async" is a Python keyword, hence the differing
+                   # constructor parameter name
+                   async_save=bool(ck.get("async", False)),
+                   max_inflight=int(ck.get("max_inflight", 1)))
 
     def latest(self) -> Optional[int]:
         return self._manager.latest_step()
@@ -165,6 +293,20 @@ class Checkpointer:
         fault.bump("ckpt_retries")
 
     def save(self, it: int, state, extras: Optional[dict] = None) -> None:
+        """Persist ``state`` as step ``it`` (+ optional pipeline sidecar).
+
+        Sync mode (default): blocks for the full serialize+write, under the
+        retry policy.  Async mode: blocks only for the device→host snapshot
+        and hands the write to the background thread; this call is also a
+        *synchronization point* — a previously enqueued write that failed
+        after retries re-raises here (:class:`AsyncCheckpointError`).
+        """
+        if self.async_save:
+            self._save_async(it, state, extras)
+        else:
+            self._save_sync(it, state, extras)
+
+    def _save_sync(self, it: int, state, extras: Optional[dict]) -> None:
         import orbax.checkpoint as ocp
 
         from . import fault
@@ -172,31 +314,171 @@ class Checkpointer:
         def _save():
             fault.get_injector().check_fail_point("ckpt_save")
             self._manager.save(it, args=ocp.args.StandardSave(state))
+            self._manager.wait_until_finished()
 
         self.retry.call(_save, on_retry=self._count_retry)
+        self._after_commit(it, extras)
+
+    # ------------------------------------------------------- async save path
+    def _save_async(self, it: int, state, extras: Optional[dict]) -> None:
+        self._raise_deferred()  # sync point: surface the last write's failure
+        while len(self._inflight) >= self.max_inflight:
+            # inflight bound reached: block on the OLDEST write — bounded
+            # memory (snapshots are full host copies of the state), and
+            # FIFO order means the oldest is the one finishing first
+            self._join_oldest()
+            self._raise_deferred()
+        snapshot = self._snapshot(state)
+        if snapshot is None:
+            # non-addressable sharded leaves (multi-host model sharding):
+            # a host snapshot is impossible here, so this step saves
+            # synchronously — after draining, so the collective sync save
+            # can never race the background writer on the manager
+            self.drain(raise_errors=True)
+            self._save_sync(it, state, extras)
+            return
+        if self._writer is None:
+            self._writer = _AsyncWriter()
+        extras = dict(extras) if extras is not None else None
+        pending = self._writer.submit(
+            lambda: self._write_async(it, snapshot, extras)
+        )
+        self._inflight.append((it, pending))
+
+    def _snapshot(self, state):
+        """Device→host copy of ``state`` (the only blocking part of an
+        async save), or None when any leaf is not fully addressable from
+        this process — those can't be gathered host-side without a
+        collective, so the caller falls back to a sync save."""
+        for leaf in jax.tree.leaves(state):
+            if isinstance(leaf, jax.Array) and not (
+                leaf.is_fully_addressable
+                or getattr(leaf.sharding, "is_fully_replicated", False)
+            ):
+                if not self._async_fallback_warned:
+                    self._async_fallback_warned = True
+                    logging.getLogger(__name__).warning(
+                        "checkpoint.async: state has non-addressable sharded "
+                        "leaves (multi-host model sharding) — saves fall "
+                        "back to the synchronous collective path"
+                    )
+                return None
+        # the snapshot is what makes async safe under donated step buffers
+        # (engine/steps.py donates the previous state into each step): the
+        # background write must never read live device memory
+        return jax.device_get(state)
+
+    def _write_async(self, it: int, snapshot, extras: Optional[dict]) -> None:
+        """Runs on the writer thread: retried write, then commit effects."""
+        import orbax.checkpoint as ocp
+
+        from . import fault
+
+        def _write():
+            fault.get_injector().check_fail_point("ckpt_async_write")
+            self._manager.save(it, args=ocp.args.StandardSave(snapshot))
+            self._manager.wait_until_finished()
+
+        self.retry.call(_write, on_retry=self._count_retry)
+        self._after_commit(it, extras)
+        fault.bump("ckpt_async_commits")
+
+    def _join_oldest(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the oldest in-flight write; False on timeout.  A failed
+        write moves to the deferred-error list (raised at a sync point)."""
+        step, pending = self._inflight[0]
+        if not pending.join(timeout):
+            return False
+        self._inflight.popleft()
+        if pending.error is not None:
+            self._deferred.append((step, pending.error))
+        return True
+
+    def _raise_deferred(self) -> None:
+        if not self._deferred:
+            return
+        failures = list(self._deferred)
+        self._deferred.clear()
+        step, err = failures[0]
+        raise AsyncCheckpointError(
+            f"async checkpoint write for step {step} failed after retries "
+            f"({len(failures)} failed write(s) pending at this "
+            f"synchronization point): {type(err).__name__}: {err}"
+        ) from err
+
+    def drain(self, raise_errors: bool = True,
+              timeout: Optional[float] = None) -> bool:
+        """Block until every in-flight async write finished (the commit
+        barrier); False when ``timeout`` expired with writes still pending.
+
+        ``raise_errors=False`` is the recovery/teardown flavor — rollback
+        and emergency saves must proceed even when a periodic save just
+        failed (the restore IS the recovery); failures are logged and
+        dropped instead of raised.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        drained = True
+        while self._inflight:
+            left = (
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+            if not self._join_oldest(left):
+                logging.getLogger(__name__).warning(
+                    "async checkpoint writer still busy on step %d after "
+                    "%.1fs drain timeout — proceeding without it (daemon "
+                    "writer thread cannot block process exit)",
+                    self._inflight[0][0], timeout,
+                )
+                drained = False
+                break
+        if raise_errors:
+            self._raise_deferred()
+        else:
+            for step, err in self._deferred:
+                logging.getLogger(__name__).warning(
+                    "dropping failed async checkpoint write for step %d "
+                    "(%s: %s) — recovery path continues without it",
+                    step, type(err).__name__, err,
+                )
+            self._deferred.clear()
+        return drained
+
+    def _after_commit(self, it: int, extras: Optional[dict]) -> None:
+        """Post-commit effects, strictly AFTER the checkpoint is durable:
+        the sidecar (which must never advertise a step that doesn't exist)
+        and sidecar GC."""
         if extras is not None and jax.process_index() == 0:
             self._write_extras(it, dict(extras))
+        self._known_steps.add(it)
+        if self.max_to_keep and len(self._known_steps) > self.max_to_keep:
+            # a garbage-collection event: orbax just pruned the oldest
+            # step(s).  Diff against the manager's step list and remove
+            # exactly those sidecars — the non-GC saves (the common case)
+            # no longer glob+sort the whole checkpoint dir.
+            kept = set(self._manager.all_steps())
+            removed = self._known_steps - kept
+            self._known_steps &= kept
+            if jax.process_index() == 0:
+                for step in removed:
+                    try:
+                        os.remove(self._extras_path(step))
+                    except OSError:
+                        pass
 
     # ------------------------------------------------ pipeline-state sidecar
     def _extras_path(self, step: int) -> str:
         return os.path.join(self.directory, f"pipeline_{step}.json")
 
     def _write_extras(self, step: int, extras: dict) -> None:
-        """Atomically write the input-pipeline sidecar for ``step`` and
-        prune sidecars of garbage-collected checkpoint steps (best effort
-        — an orphan sidecar is harmless, its step is never restored)."""
+        """Atomically write the input-pipeline sidecar for ``step`` (an
+        orphan sidecar is harmless — its step is never restored — and a
+        missing one degrades to the pre-sidecar resume, so pruning is
+        deferred to GC events in ``_after_commit``)."""
         tmp = self._extras_path(step) + f".tmp{os.getpid()}"
         with open(tmp, "w") as fp:
             json.dump(extras, fp)
         os.replace(tmp, self._extras_path(step))
-        try:
-            keep = set(self.all_steps()) | {step}
-            for path in glob.glob(os.path.join(self.directory, "pipeline_*.json")):
-                m = re.match(r"pipeline_(\d+)\.json$", os.path.basename(path))
-                if m and int(m.group(1)) not in keep:
-                    os.remove(path)
-        except OSError:
-            pass
 
     def read_extras(self, step: int) -> Optional[dict]:
         """The sidecar saved alongside checkpoint ``step`` (periodic sidecar
@@ -253,6 +535,13 @@ class Checkpointer:
         from ..parallel.mesh import mesh_axis_sizes
         from . import fault
 
+        # Drain the async writer first so two writers never race on the
+        # checkpoint dir.  Bounded wait, errors dropped: with a dead peer a
+        # background write can be wedged in a stuck filesystem op, and the
+        # emergency dump must still happen — it goes to its own subdir, and
+        # an abandoned half-written orbax step stays uncommitted (tmp-dir
+        # name), invisible to restore.
+        self.drain(raise_errors=False, timeout=30.0)
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         arrays = {}
         specs = {}
@@ -685,9 +974,17 @@ class Checkpointer:
         return out
 
     def wait(self) -> None:
+        """Full commit barrier: drain in-flight async writes — raising any
+        deferred write failure at this synchronization point — then block
+        on the manager itself."""
+        self.drain(raise_errors=True)
         self._manager.wait_until_finished()
 
     def close(self) -> None:
+        self.drain(raise_errors=False)
+        if self._writer is not None:
+            self._writer.stop(timeout=5.0)
+            self._writer = None
         self._manager.close()
 
 

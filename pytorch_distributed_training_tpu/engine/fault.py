@@ -27,6 +27,12 @@ Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
                        attempt ordinal across the process; the retry policy
                        must absorb them)
     restore_fail@A[:N] same for checkpoint-restore attempts
+    ckpt_async_fail@A[:N]
+                       same for ASYNC checkpoint-write attempts — fires on
+                       the background writer thread (the ``ckpt_async_write``
+                       fail point), so the chaos harness can kill an
+                       in-flight overlapped save deterministically and prove
+                       the deferred-error + restore-fallback contract
 
 Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``) are
 one-shot: consumed when they fire, so a rollback replay of the same step
@@ -60,7 +66,11 @@ __all__ = [
 ENV_VAR = "PDT_FAULT_SPEC"
 
 _STEP_KINDS = ("nan_batch", "kill_worker", "stall_step", "kill_peer")
-_POINT_KINDS = {"ckpt_fail": "ckpt_save", "restore_fail": "ckpt_restore"}
+_POINT_KINDS = {
+    "ckpt_fail": "ckpt_save",
+    "restore_fail": "ckpt_restore",
+    "ckpt_async_fail": "ckpt_async_write",
+}
 
 
 class FaultInjectionError(OSError):

@@ -752,7 +752,11 @@ class Runner:
             iter_generator.close()
         except Exception:  # pragma: no cover - abandoned stream cleanup
             pass
-        self.checkpointer.wait()  # an async save may still be in flight
+        # flush the in-flight async save before restoring: the writer must
+        # not race the restore on the checkpoint dir, and a save that
+        # FAILED in the background must not abort the rollback — the
+        # restore is the recovery (errors are logged and dropped)
+        self.checkpointer.drain(raise_errors=False)
         self.state, start_iter = self.checkpointer.restore_latest(
             self.state, self.logger
         )
@@ -843,8 +847,9 @@ class Runner:
                     self.iter, self.state, extras=self._pipeline_extras()
                 )
                 if self.profiler:
-                    # orbax saves are async — block until the write finishes
-                    # so the window can't reopen over in-flight checkpoint I/O
+                    # with checkpoint.async the write is in flight — block
+                    # until it commits so the profiler window can't reopen
+                    # over background checkpoint I/O
                     self.checkpointer.wait()
             self.iter += 1
 

@@ -612,6 +612,231 @@ def test_dp_checkpoint_restores_into_resharded_run(tmp_path, target_extra):
     assert int(cont.state.step) == 3
 
 
+# ----------------------------------------------------------------------
+# Async overlapped checkpointing (ISSUE 5): the save step blocks only for
+# the host snapshot; the write happens on a background thread with errors
+# deferred to the next synchronization point, the sidecar strictly after
+# the commit, and a crash mid-write indistinguishable from the existing
+# truncated-checkpoint fallback case.
+# ----------------------------------------------------------------------
+def _tiny_state(fill):
+    from pytorch_distributed_training_tpu.engine import TrainState
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import replicated_sharding
+    from pytorch_distributed_training_tpu.parallel.mesh import make_mesh
+
+    opt = SGD(lr=0.1, momentum=0.9)
+    params = {"w": jnp.full((8, 4), float(fill)), "b": jnp.full((4,), float(fill))}
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    return jax.device_put(state, replicated_sharding(make_mesh()))
+
+
+def test_async_save_commits_and_roundtrips(tmp_path):
+    """Async saves commit durably (values round-trip exactly), write the
+    sidecar only after the commit, and prune sidecars exactly on the
+    garbage-collection events that evict their steps."""
+    import os
+
+    ck = Checkpointer(str(tmp_path / "c"), interval=1, max_to_keep=2,
+                      async_save=True, max_inflight=1)
+    assert ck.async_save and ck.max_inflight == 1
+    for it in range(4):
+        ck.save(it, _tiny_state(it), extras={"epoch": it})
+    ck.wait()  # commit barrier: every enqueued write is durable past here
+    assert ck.all_steps() == [2, 3]  # max_to_keep=2 evicted steps 0 and 1
+    # evicted steps lost their sidecars on the GC event; kept steps didn't
+    sidecars = sorted(
+        f for f in os.listdir(str(tmp_path / "c")) if f.startswith("pipeline_")
+    )
+    assert sidecars == ["pipeline_2.json", "pipeline_3.json"]
+    assert ck.read_extras(3) == {"epoch": 3}
+
+    restored, next_iter = ck.restore_latest(_tiny_state(0.0))
+    ck.close()
+    assert next_iter == 4
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w"]), np.full((8, 4), 3.0)
+    )
+
+
+def test_async_config_surface(tmp_path):
+    """training.checkpoint.async / max_inflight parse additively; a
+    nonsensical inflight bound is rejected at construction."""
+    ck = Checkpointer.from_config({
+        "checkpoint": {"dir": str(tmp_path / "a"), "async": True,
+                       "max_inflight": 2},
+    })
+    assert ck.async_save and ck.max_inflight == 2
+    ck.close()
+    ck2 = Checkpointer.from_config({"checkpoint": {"dir": str(tmp_path / "b")}})
+    assert not ck2.async_save  # default off: sync semantics unchanged
+    ck2.close()
+    with pytest.raises(ValueError, match="max_inflight"):
+        Checkpointer(str(tmp_path / "x"), max_inflight=0)
+
+
+def test_async_write_failure_surfaces_at_next_sync_point(tmp_path):
+    """A background write that exhausts its retry budget must not vanish:
+    the NEXT save (a synchronization point) raises AsyncCheckpointError
+    chaining the storage error, and the failed step is never visible to
+    restore."""
+    from pytorch_distributed_training_tpu.engine import fault
+    from pytorch_distributed_training_tpu.engine.checkpoint import (
+        AsyncCheckpointError,
+    )
+    from pytorch_distributed_training_tpu.engine.fault import FaultInjectionError
+    from pytorch_distributed_training_tpu.utils.retry import Retry
+
+    fault.reset_counters()
+    ck = Checkpointer(str(tmp_path / "c"), interval=1, async_save=True,
+                      retry=Retry(attempts=1))
+    try:
+        ck.save(0, _tiny_state(0.0))
+        ck.wait()  # step 0 durably committed before the fault window opens
+        fault.install("ckpt_async_fail@0:99")
+        ck.save(1, _tiny_state(1.0))  # background write fails, no budget left
+        with pytest.raises(AsyncCheckpointError, match="step 1") as exc_info:
+            ck.save(2, _tiny_state(2.0))
+        assert isinstance(exc_info.value.__cause__, FaultInjectionError)
+        assert fault.counters().get("injected_ckpt_async_write_failures") == 1
+        # recovery flavor: drain without raising drops the failure (logged)
+        ck.drain(raise_errors=False)
+        assert ck.all_steps() == [0]  # the failed write never committed
+        restored, next_iter = ck.restore_latest(_tiny_state(9.0))
+        assert next_iter == 1  # previous committed step restores
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.full((8, 4), 0.0)
+        )
+    finally:
+        ck.close()
+        fault.install(None)
+        fault.reset_counters()
+
+
+def test_crash_during_async_write_falls_back_like_truncated_step(tmp_path):
+    """Kill-during-async-write (extends the corrupt-fallback battery): the
+    interrupted write leaves only an UNCOMMITTED tmp step dir — orbax's
+    atomic-rename commit never ran — so restore_latest must treat it like
+    the truncated-checkpoint case and hand back the previous committed
+    step, without even burning a fallback."""
+    import os
+
+    from pytorch_distributed_training_tpu.engine import fault
+    from pytorch_distributed_training_tpu.utils.retry import Retry
+
+    fault.reset_counters()
+    ck = Checkpointer(str(tmp_path / "c"), interval=1, async_save=True,
+                      retry=Retry(attempts=1))
+    try:
+        ck.save(1, _tiny_state(1.0))
+        ck.wait()
+        fault.install("ckpt_async_fail@0:99")
+        ck.save(3, _tiny_state(3.0))  # dies on the writer thread
+        ck.drain(raise_errors=False)
+        # the crash artifact a mid-write kill leaves on disk: a partial,
+        # uncommitted tmp directory for the step
+        tmp_dir = os.path.join(ck.directory, "3.orbax-checkpoint-tmp-123456")
+        os.makedirs(tmp_dir)
+        with open(os.path.join(tmp_dir, "partial"), "w") as fp:
+            fp.write("truncated")
+
+        assert ck.all_steps() == [1]  # the tmp dir is invisible
+        restored, next_iter = ck.restore_latest(_tiny_state(0.0))
+        assert next_iter == 2
+        np.testing.assert_array_equal(
+            np.asarray(restored.params["w"]), np.full((8, 4), 1.0)
+        )
+        # no fallback was needed: the uncommitted step was never a candidate
+        assert "ckpt_fallbacks" not in fault.counters()
+    finally:
+        ck.close()
+        fault.install(None)
+        fault.reset_counters()
+
+
+def test_sidecar_missing_for_committed_step_tolerated(tmp_path, one_device_graft):
+    """Satellite regression (sidecar/commit ordering): a checkpoint whose
+    sidecar is gone — the old ordering could crash between manager.save and
+    the sidecar write; GC pruning can also race a crash — must still
+    resume, deriving the pipeline position from the step counter."""
+    import os
+
+    _run(_cfg(tmp_path, train_iters=2))  # interval=2 -> save at step 1
+    sidecar = os.path.join(str(tmp_path / "ckpt"), "pipeline_1.json")
+    assert os.path.exists(sidecar)
+    os.remove(sidecar)  # the crash-at-the-boundary artifact
+
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    assert ck.read_extras(1) is None  # absence-tolerant, no raise
+    ck.close()
+
+    resumed = _run(_cfg(tmp_path, train_iters=4))
+    assert resumed.iter == 4  # resumed from step 1 without the sidecar
+
+
+def test_resume_bit_exact_async_vs_straight_run(tmp_path, one_device_graft):
+    """The async-save pipeline end to end through the Runner: 4 iters
+    straight == 2 iters + async checkpoint + resume 2 more, bit-exact —
+    the snapshot/overlapped write must save exactly the state the sync
+    path would have."""
+    straight = _run(_cfg(tmp_path / "a", ckpt=False, train_iters=4))
+
+    cfg_b = _cfg(tmp_path / "b", train_iters=2)
+    cfg_b["training"]["checkpoint"]["async"] = True
+    _run(cfg_b)
+    cfg_b2 = _cfg(tmp_path / "b", train_iters=4)
+    cfg_b2["training"]["checkpoint"]["async"] = True
+    resumed = _run(cfg_b2)
+
+    a = jax.tree.map(np.asarray, straight.state.params)
+    b = jax.tree.map(np.asarray, resumed.state.params)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.slow
+def test_bench_ckpt_cli():
+    """End-to-end ``bench.py ckpt`` at a tiny config: one JSON line with
+    the sync/async stall A/B, bytes written, overlap efficiency, and the
+    kill-during-async-write probe restoring the previous committed step."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PDT_JAX_COMPAT="1",  # inert on grafted JAX; single device = exact
+        PYTHONPATH=root + os.pathsep + env.get("PYTHONPATH", ""),
+        BENCH_CKPT_ITERS="8", BENCH_CKPT_INTERVAL="4",
+        BENCH_CKPT_VOCAB="256", BENCH_CKPT_SEQ="32", BENCH_CKPT_EMBED="32",
+        BENCH_CKPT_DEPTH="2", BENCH_CKPT_HEADS="4", BENCH_CKPT_BATCH="2",
+        BENCH_COMPILE_CACHE="0",
+    )
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "ckpt"],
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = _json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["unit"] == "ms"
+    assert out["nonsave_step_ms"] > 0
+    assert out["sync_save_step_ms"] > 0 and out["async_save_step_ms"] > 0
+    assert out["bytes_written"] > 0
+    # the chaos probe: the killed background write never committed, and
+    # restore handed back the previous durable step
+    assert out["chaos_uncommitted_step_dropped"] is True
+    assert out["chaos_resume_iter"] == 1
+    assert out.get("chaos_injected_ckpt_async_write_failures", 0) >= 1
+    # at this toy size timing is noise; the acceptance-bar stall numbers
+    # are checked on the real bench config (PERF.md), not here — but the
+    # fields must exist for the driver to read
+    assert "overlap_efficiency" in out and "sync_stall_ms" in out
+
+
 @pytest.mark.slow
 def test_restore_at_different_device_count(tmp_path):
     """batch_division: world — a checkpoint written on the 8-device mesh

@@ -483,3 +483,50 @@ def test_peer_loss_recovery_end_to_end_single_process(tmp_path, monkeypatch,
         np.asarray(oracle.losses[6:]), np.asarray(resumed.losses),
         err_msg="post-resume trajectory diverged from the uninterrupted run",
     )
+
+
+def test_emergency_save_drains_async_writer_first(tmp_path):
+    """save_emergency must drain the in-flight background write before its
+    local dump (ISSUE 5): two writers never race on the checkpoint dir,
+    and the state the periodic save was carrying commits durably before
+    the emergency artifacts appear.  Pinned by gating the orbax write on
+    an event the test releases only after save_emergency has been called —
+    if the drain were missing, the periodic step would still be
+    uncommitted when the emergency dump returned."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_training_tpu.engine import TrainState
+    from pytorch_distributed_training_tpu.engine.checkpoint import Checkpointer
+    from pytorch_distributed_training_tpu.optimizers import SGD
+    from pytorch_distributed_training_tpu.parallel import replicated_sharding
+    from pytorch_distributed_training_tpu.parallel.mesh import make_mesh
+
+    opt = SGD(lr=0.1)
+    params = {"w": jnp.ones((4, 4))}
+    state = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state = jax.device_put(state, replicated_sharding(make_mesh()))
+
+    ck = Checkpointer(str(tmp_path / "c"), interval=1, async_save=True)
+    gate = threading.Event()
+    orig_save = ck._manager.save
+
+    def gated_save(step, *a, **kw):
+        gate.wait(10.0)  # hold the background write until released
+        return orig_save(step, *a, **kw)
+
+    ck._manager.save = gated_save
+    try:
+        ck.save(1, state)  # enqueued; the writer thread is parked on the gate
+        assert ck.all_steps() == []  # provably still in flight
+        threading.Timer(0.2, gate.set).start()
+        ck.save_emergency(2, state)
+        committed_before_emergency = ck.all_steps()
+    finally:
+        gate.set()
+        ck._manager.save = orig_save
+        ck.close()
+    # the drain ran first: the gated periodic write was durable before the
+    # emergency dump returned
+    assert committed_before_emergency == [1]
+    assert ck.latest_emergency() == 2

@@ -191,6 +191,13 @@ def test_fault_spec_parsing_and_one_shot():
     # the restore point is independent of the save point
     inj.check_fail_point("ckpt_restore")
     assert not FaultInjector("").active
+    # the async-write point (background writer thread) is its own ordinal
+    # space too: ckpt_async_fail windows never consume ckpt_save attempts
+    inj2 = FaultInjector("ckpt_async_fail@0:1")
+    inj2.check_fail_point("ckpt_save")  # untouched by the async window
+    with pytest.raises(FaultInjectionError):
+        inj2.check_fail_point("ckpt_async_write")
+    inj2.check_fail_point("ckpt_async_write")  # window exhausted
 
 
 @pytest.mark.parametrize(
@@ -216,7 +223,7 @@ def test_unknown_fault_kind_names_the_valid_kinds():
         FaultInjector("kil_peer@3")
     msg = str(ei.value)
     for kind in ("nan_batch", "kill_worker", "stall_step", "kill_peer",
-                 "ckpt_fail", "restore_fail"):
+                 "ckpt_fail", "restore_fail", "ckpt_async_fail"):
         assert kind in msg, f"{kind!r} missing from the error menu: {msg}"
 
 
@@ -442,6 +449,51 @@ def test_runner_consecutive_anomalies_rollback_and_resume(tmp_path, one_device_m
     assert int(runner.state.step) == 4
     for leaf in jax.tree.leaves(jax.tree.map(np.asarray, runner.state.params)):
         assert np.isfinite(leaf).all()
+
+
+def test_rollback_flushes_async_writer_before_restore(tmp_path, one_device_mesh,
+                                                      monkeypatch):
+    """Async checkpointing composes with the anomaly-guard rollback: the
+    Runner must flush (drain, errors dropped) the background writer BEFORE
+    restore_latest touches the checkpoint dir — two actors must never race
+    on it, and a failed periodic save must not abort the recovery.  The
+    rollback scenario itself must still complete end to end with async
+    saves on."""
+    from pytorch_distributed_training_tpu.engine.checkpoint import Checkpointer
+
+    calls = []
+    orig_drain = Checkpointer.drain
+    orig_restore = Checkpointer.restore_latest
+
+    def spy_drain(self, *a, **kw):
+        calls.append(("drain", kw.get("raise_errors", a[0] if a else True)))
+        return orig_drain(self, *a, **kw)
+
+    def spy_restore(self, *a, **kw):
+        calls.append(("restore", None))
+        return orig_restore(self, *a, **kw)
+
+    monkeypatch.setattr(Checkpointer, "drain", spy_drain)
+    monkeypatch.setattr(Checkpointer, "restore_latest", spy_restore)
+
+    cfg = _ft_cfg(
+        tmp_path, train_iters=6, ckpt=True, interval=2,
+        fault_spec="nan_batch@2;nan_batch@3;nan_batch@4",
+        anomaly={"enabled": True, "max_consecutive": 3},
+    )
+    cfg["training"]["checkpoint"]["async"] = True
+    runner = _run(cfg)
+    assert runner.iter == 6
+    assert fault.counters().get("rollbacks") == 1
+    assert int(runner.state.step) == 4  # 0,1 + replayed 4,5 (burst skipped)
+
+    # the rollback's restore (the startup resume also calls restore_latest,
+    # on the then-empty dir) must be guarded IMMEDIATELY by the
+    # error-dropping flush flavor
+    assert any(
+        calls[i] == ("drain", False) and calls[i + 1] == ("restore", None)
+        for i in range(len(calls) - 1)
+    ), f"no drain(raise_errors=False) directly before restore_latest: {calls}"
 
 
 def test_runner_rollback_without_checkpoint_is_loud(tmp_path, one_device_mesh):

@@ -12,6 +12,13 @@ import ast
 import pathlib
 
 
+# Anything that runs a bench — shelling out to bench.py OR calling a bench
+# entry point in-process (import bench / bench_ckpt() / bench_chaos(), the
+# ckpt-overlap and chaos modes both train real models) — pays compiles and
+# timed windows and must not ride the default tier.
+_BENCH_DRIVERS = ("bench.py", "import bench", "bench_ckpt(", "bench_chaos(")
+
+
 def test_bench_driving_tests_are_slow_marked():
     here = pathlib.Path(__file__).parent
     offenders = []
@@ -25,14 +32,15 @@ def test_bench_driving_tests_are_slow_marked():
             if not node.name.startswith("test_"):
                 continue
             body_src = ast.unparse(node)
-            if "bench.py" not in body_src:
+            if not any(b in body_src for b in _BENCH_DRIVERS):
                 continue
             decorators = [ast.unparse(d) for d in node.decorator_list]
             if not any("slow" in d for d in decorators):
                 offenders.append(f"{path.name}::{node.name}")
     assert not offenders, (
-        "tests driving bench.py must be @pytest.mark.slow (tier-1 runs "
-        f"-m 'not slow' in a fixed budget): {offenders}"
+        "tests driving bench.py (subprocess or in-process bench_* entry "
+        "points) must be @pytest.mark.slow (tier-1 runs -m 'not slow' in "
+        f"a fixed budget): {offenders}"
     )
 
 
