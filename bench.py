@@ -1022,6 +1022,150 @@ def bench_ckpt():
     )
 
 
+def bench_telemetry():
+    """Telemetry-overhead mode: the same short LM run with the unified
+    telemetry layer OFF vs ON (spans + goodput + retrace poll + periodic
+    snapshot — the exact per-step work the Runner's loop does), median
+    step time each way.  One JSON line:
+
+      off/on_step_ms    median per-step wall time per phase
+      overhead_ms/pct   on minus off; the acceptance bar is <= 1% of the
+                        mean step (ISSUE 6 / PERF.md)
+
+      BENCH_TELEMETRY_ITERS  steps per phase (default 80)
+      BENCH_CKPT_VOCAB/SEQ/EMBED/DEPTH/HEADS/BATCH  LM shapes (shared with
+                        the ckpt mode so A/B step costs are comparable)
+    """
+    import statistics
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import TrainState
+    from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+    from pytorch_distributed_training_tpu.ops import cross_entropy_loss
+    from pytorch_distributed_training_tpu.optimizers import AdamW
+    from pytorch_distributed_training_tpu.parallel import (
+        make_sp_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import cosine_lr
+    from pytorch_distributed_training_tpu.telemetry import Telemetry
+    from pytorch_distributed_training_tpu.telemetry.retrace import (
+        register_compiled,
+    )
+
+    iters = int(os.environ.get("BENCH_TELEMETRY_ITERS", "80"))
+    vocab = int(os.environ.get("BENCH_CKPT_VOCAB", "8192"))
+    seq = int(os.environ.get("BENCH_CKPT_SEQ", "128"))
+    embed = int(os.environ.get("BENCH_CKPT_EMBED", "256"))
+    depth = int(os.environ.get("BENCH_CKPT_DEPTH", "2"))
+    heads = int(os.environ.get("BENCH_CKPT_HEADS", "4"))
+    batch = int(os.environ.get("BENCH_CKPT_BATCH", "8"))
+
+    mesh = make_sp_mesh(sequence_parallelism=1)
+    lm = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=embed, depth=depth,
+        num_heads=heads, dtype=jnp.bfloat16,
+    )
+    opt = AdamW(lr=3e-4, weight_decay=0.1)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, vocab, (batch, seq + 1)).astype(np.int32)
+    params = lm.init(jax.random.PRNGKey(0), jnp.asarray(tokens[:1, :seq]))["params"]
+    state0 = TrainState(params=params, batch_stats={}, opt_state=opt.init(params))
+    state0 = jax.device_put(state0, replicated_sharding(mesh))
+    # Plain jitted step (no shard_map): the probe measures HOST-side
+    # telemetry cost against a representative device step, and the SP
+    # builder's shard_map is absent from some CPU builds — parallelism
+    # would only change the device half of the A/B anyway
+    lr_fn = cosine_lr(3e-4, 100000)
+
+    def _step(state, tokens_in, labels_in):
+        def loss_fn(p):
+            logits = lm.apply({"params": p}, tokens_in)
+            return cross_entropy_loss(
+                logits.reshape(-1, vocab), labels_in.reshape(-1)
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        new_params, new_opt = opt.update(
+            grads, state.opt_state, state.params, lr_fn(state.opt_state.step)
+        )
+        return state.replace(params=new_params, opt_state=new_opt), loss
+
+    step = register_compiled(
+        "bench_telemetry/lm_step", jax.jit(_step, donate_argnums=(0,))
+    )
+    inp = jax.device_put(jnp.asarray(tokens[:, :-1]), replicated_sharding(mesh))
+    lab = jax.device_put(jnp.asarray(tokens[:, 1:]), replicated_sharding(mesh))
+
+    state_host = jax.device_get(state0)
+    del state0
+
+    def fresh_state():
+        return jax.device_put(state_host, replicated_sharding(mesh))
+
+    warm = fresh_state()
+    for _ in range(3):
+        warm, loss = step(warm, inp, lab)
+    float(loss)
+    del warm
+
+    def run_phase(tel):
+        """iters steps through the Runner loop's telemetry motions."""
+        state = fresh_state()
+        times = []
+        try:
+            for it in range(iters):
+                t0 = time.perf_counter()
+                with tel.span("data_wait", step=it):
+                    pass  # device-resident inputs: the wait is the span cost
+                with tel.span("step_dispatch", step=it):
+                    state, loss = step(state, inp, lab)
+                with tel.span("device_block", step=it):
+                    float(loss)  # per-step host sync: timing needs real steps
+                tel.note_step(time.perf_counter() - t0, applied=True)
+                tel.after_step(it)
+                times.append(time.perf_counter() - t0)
+        finally:
+            tel.close(step=iters - 1)
+        return times
+
+    with tempfile.TemporaryDirectory(prefix="bench_tel_") as tmp:
+        off = run_phase(Telemetry(enabled=False))
+        on = run_phase(
+            Telemetry(
+                enabled=True, dir=os.path.join(tmp, "telemetry"),
+                snapshot_interval=25, use_tensorboard=False,
+            )
+        )
+    off_ms = statistics.median(off) * 1e3
+    on_ms = statistics.median(on) * 1e3
+    mean_off_ms = statistics.fmean(off) * 1e3
+    overhead_ms = on_ms - off_ms
+    print(
+        json.dumps(
+            {
+                "metric": f"unified-telemetry per-step overhead (LM "
+                f"{sum(x.size for x in jax.tree_util.tree_leaves(params)) / 1e6:.0f}M"
+                f", spans+goodput+retrace+snapshot every 25)",
+                "value": round(overhead_ms, 3),
+                "unit": "ms",
+                # fraction of a step the full telemetry surface costs;
+                # acceptance bar <= 0.01 (1% of the mean step)
+                "vs_baseline": round(overhead_ms / mean_off_ms, 4),
+                "baseline": "same loop, telemetry disabled",
+                "off_step_ms": round(off_ms, 3),
+                "on_step_ms": round(on_ms, 3),
+                "mean_off_step_ms": round(mean_off_ms, 3),
+                "iters_per_phase": iters,
+            }
+        )
+    )
+
+
 def bench_chaos():
     """Chaos mode: the smoke run under a standard fault script, end to end.
 
@@ -1089,6 +1233,12 @@ def bench_chaos():
                     },
                     "fault_spec": spec,
                 },
+                # full telemetry surface under chaos: the snapshot JSONL is
+                # re-read below so the bench line carries goodput/retrace
+                "telemetry": {
+                    "dir": os.path.join(tmp, "telemetry"),
+                    "snapshot_interval": 5,
+                },
             },
             "validation": {"batch_size": 8, "num_workers": 1},
             "model": {"name": "ResNet18"},
@@ -1105,6 +1255,15 @@ def bench_chaos():
             final_iter = runner.iter
         finally:
             fault.install(None)  # don't leak the injector into other modes
+        # last telemetry snapshot of the run (written by Telemetry.close)
+        tel_snap = None
+        snap_path = os.path.join(tmp, "telemetry", "snapshots.jsonl")
+        try:
+            with open(snap_path) as f:
+                lines = [ln for ln in f if ln.strip()]
+            tel_snap = json.loads(lines[-1]) if lines else None
+        except OSError:
+            pass
     counters = fault.counters()
     recoveries = sum(
         counters.get(k, 0)
@@ -1122,6 +1281,23 @@ def bench_chaos():
                 "final_iter": final_iter,
                 "completed": final_iter >= iters,
                 **counters,
+                **(
+                    {
+                        "goodput_ratio": tel_snap["goodput"]["goodput_ratio"],
+                        "replayed_steps": tel_snap["goodput"]["replayed_steps"],
+                        "skipped_steps_goodput": tel_snap["goodput"]["skipped_steps"],
+                        "ckpt_stall_ms_p50": (
+                            tel_snap["histograms"]
+                            .get(
+                                "ckpt_async_stall_ms" if use_async
+                                else "ckpt_sync_save_ms", {}
+                            )
+                            .get("p50")
+                        ),
+                        "retrace_entries": len(tel_snap.get("compiles", {})),
+                    }
+                    if tel_snap is not None else {"telemetry_snapshot": None}
+                ),
             }
         )
     )
@@ -1302,6 +1478,8 @@ if __name__ == "__main__":
         bench_flash()
     elif mode == "ckpt":
         bench_ckpt()
+    elif mode == "telemetry":
+        bench_telemetry()
     elif mode in ("serve", "--serve"):
         bench_serve()
     elif mode in ("chaos", "--chaos"):

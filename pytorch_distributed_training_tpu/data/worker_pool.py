@@ -132,6 +132,9 @@ class ProcessLoaderPool:
         # can't undercount: accounting happens at submit/collect time, never
         # in a generator finally that may not have run yet
         self._outstanding = 0
+        from ..telemetry.registry import get_registry
+
+        self._gauge = get_registry().gauge("data_pool_outstanding")
         self._closed = False
         # (gen, seq) -> (wid, task): every task submitted and not yet
         # collected, in submission order — the respawn ledger
@@ -223,6 +226,7 @@ class ProcessLoaderPool:
                 self._inflight[(gen, seq)] = (wid, task)
                 self._task_qs[wid].put(task)
                 self._outstanding += 1
+                self._gauge.set(self._outstanding)
             if next_yield in done:
                 slot = done.pop(next_yield)
                 out = postprocess(self._slots[slot], self._labels[slot])
@@ -261,6 +265,7 @@ class ProcessLoaderPool:
                     ) from None
                 continue
             self._outstanding -= 1
+            self._gauge.set(self._outstanding)
             self._inflight.pop((r[0], r[1]), None)
             return r
 

@@ -74,6 +74,9 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 
+from ..telemetry.registry import get_registry
+from ..telemetry.spans import span
+
 __all__ = ["AsyncCheckpointError", "Checkpointer", "load_serving_state"]
 
 # The layout-vs-corruption discrimination in ``_structure_differs`` relies
@@ -301,10 +304,21 @@ class Checkpointer:
         *synchronization point* — a previously enqueued write that failed
         after retries re-raises here (:class:`AsyncCheckpointError`).
         """
-        if self.async_save:
-            self._save_async(it, state, extras)
-        else:
-            self._save_sync(it, state, extras)
+        # observability: how long this call blocked the training thread —
+        # for async saves that is the STALL the overlap is supposed to hide
+        # (snapshot + any inflight-bound wait), for sync saves the full
+        # serialize+write
+        t0 = time.monotonic()
+        try:
+            if self.async_save:
+                self._save_async(it, state, extras)
+            else:
+                self._save_sync(it, state, extras)
+        finally:
+            name = "ckpt_async_stall_ms" if self.async_save else "ckpt_sync_save_ms"
+            get_registry().histogram(name).observe(
+                (time.monotonic() - t0) * 1e3
+            )
 
     def _save_sync(self, it: int, state, extras: Optional[dict]) -> None:
         import orbax.checkpoint as ocp
@@ -328,7 +342,8 @@ class Checkpointer:
             # FIFO order means the oldest is the one finishing first
             self._join_oldest()
             self._raise_deferred()
-        snapshot = self._snapshot(state)
+        with span("ckpt_snapshot", step=it):
+            snapshot = self._snapshot(state)
         if snapshot is None:
             # non-addressable sharded leaves (multi-host model sharding):
             # a host snapshot is impossible here, so this step saves
@@ -344,6 +359,7 @@ class Checkpointer:
             lambda: self._write_async(it, snapshot, extras)
         )
         self._inflight.append((it, pending))
+        get_registry().gauge("ckpt_async_inflight").set(len(self._inflight))
 
     def _snapshot(self, state):
         """Device→host copy of ``state`` (the only blocking part of an
@@ -379,7 +395,10 @@ class Checkpointer:
             self._manager.save(it, args=ocp.args.StandardSave(snapshot))
             self._manager.wait_until_finished()
 
-        self.retry.call(_write, on_retry=self._count_retry)
+        # span lands in the shared recorder from the writer thread: the
+        # trace shows the write overlapping the steps that hid it
+        with span("ckpt_async_write", step=it):
+            self.retry.call(_write, on_retry=self._count_retry)
         self._after_commit(it, extras)
         fault.bump("ckpt_async_commits")
 
@@ -390,8 +409,12 @@ class Checkpointer:
         if not pending.join(timeout):
             return False
         self._inflight.popleft()
+        get_registry().gauge("ckpt_async_inflight").set(len(self._inflight))
         if pending.error is not None:
+            from . import fault
+
             self._deferred.append((step, pending.error))
+            fault.bump("ckpt_deferred_errors")
         return True
 
     def _raise_deferred(self) -> None:

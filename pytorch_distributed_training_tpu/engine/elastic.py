@@ -41,6 +41,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from . import fault
+from ..telemetry.spans import span
 
 __all__ = ["ElasticCoordinator", "PeerLostError"]
 
@@ -262,19 +263,20 @@ class ElasticCoordinator:
 
         t = threading.Thread(target=_run, name="elastic-guarded", daemon=True)
         started = time.monotonic()
-        t.start()
-        poll = min(self.heartbeat_interval, self.timeout / 4.0)
-        while not done.wait(poll):
-            try:
-                self.check_peers(mid_step=True)
-            except PeerLostError as e:
-                blocked = time.monotonic() - started
-                raise PeerLostError(
-                    f"{e} — detected while blocked in {what} for "
-                    f"{blocked:.1f}s; the in-flight step is unrecoverable",
-                    dead_ranks=e.dead_ranks,
-                    mid_step=True,
-                ) from None
+        with span("elastic_guard", what=what):
+            t.start()
+            poll = min(self.heartbeat_interval, self.timeout / 4.0)
+            while not done.wait(poll):
+                try:
+                    self.check_peers(mid_step=True)
+                except PeerLostError as e:
+                    blocked = time.monotonic() - started
+                    raise PeerLostError(
+                        f"{e} — detected while blocked in {what} for "
+                        f"{blocked:.1f}s; the in-flight step is unrecoverable",
+                        dead_ranks=e.dead_ranks,
+                        mid_step=True,
+                    ) from None
         if "error" in box:
             raise box["error"]
         return box.get("result")

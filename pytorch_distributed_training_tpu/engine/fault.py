@@ -39,11 +39,14 @@ one-shot: consumed when they fire, so a rollback replay of the same step
 index does not re-trip them (the recovery itself must converge).
 
 This module is import-light on purpose (stdlib only): the data pipeline and
-serving stack consult it without pulling the JAX engine in.  Recovery
-counters live here too — one process-global ``Counter`` every subsystem
-bumps (``skipped_steps``, ``rollbacks``, ``ckpt_retries``,
-``worker_respawns``, ``watchdog_fires``, ...) so ``bench.py --chaos`` and
-the fault tests read one ledger.
+serving stack consult it without pulling the JAX engine in.  The recovery
+counters every subsystem bumps (``skipped_steps``, ``rollbacks``,
+``ckpt_retries``, ``worker_respawns``, ``watchdog_fires``, ...) live in the
+process-global telemetry registry (``telemetry/registry.py`` — also
+stdlib-only); ``bump``/``counters``/``reset_counters`` here are the
+stable API the fault layer and its tests were built on, now thin views of
+that one ledger so ``bench.py --chaos`` and the telemetry snapshot read
+the same numbers.
 """
 from __future__ import annotations
 
@@ -171,8 +174,6 @@ class FaultInjector:
 
 # ---------------------------------------------------------------- process-global
 _INJECTOR: Optional[FaultInjector] = None
-_COUNTER_LOCK = threading.Lock()
-_COUNTERS: Counter = Counter()
 
 
 def get_injector() -> FaultInjector:
@@ -195,19 +196,22 @@ def install(spec: Optional[str]) -> FaultInjector:
 
 def bump(name: str, n: int = 1) -> None:
     """Increment a process-global recovery counter (thread-safe)."""
-    with _COUNTER_LOCK:
-        _COUNTERS[name] += n
+    from ..telemetry.registry import get_registry
+
+    get_registry().counter(name).inc(n)
 
 
 def counters() -> Dict[str, int]:
-    """Snapshot of all recovery/injection counters."""
-    with _COUNTER_LOCK:
-        return dict(_COUNTERS)
+    """Snapshot of all process counters (the shared telemetry ledger)."""
+    from ..telemetry.registry import get_registry
+
+    return {k: v for k, v in get_registry().counters().items() if v}
 
 
 def reset_counters() -> None:
-    with _COUNTER_LOCK:
-        _COUNTERS.clear()
+    from ..telemetry.registry import reset_registry
+
+    reset_registry()
 
 
 def poison_batches(host_iter, injector: FaultInjector, start_iter: int = 0,

@@ -47,6 +47,7 @@ from ..models.transformer_lm import DecoderBlock
 from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
 from ..parallel.pipeline import STAGE_AXIS, pp_param_specs
 from ..parallel.tensor import mirror_opt_fields
+from ..telemetry.retrace import register_compiled
 from ..utils.vma import mark_varying
 from .sp_steps import lm_loss_local
 from .steps import TrainState
@@ -597,7 +598,10 @@ def build_pp_lm_train_step(
                     loss,
                 )
 
-            return jax.jit(step, donate_argnums=(0,) if donate else ())
+            return register_compiled(
+                "lm_train_step/pp_gspmd",
+                jax.jit(step, donate_argnums=(0,) if donate else ()),
+            )
 
         sharded = jax.shard_map(
             step_body,
@@ -619,7 +623,10 @@ def build_pp_lm_train_step(
                 loss,
             )
 
-        return jax.jit(step, donate_argnums=(0,) if donate else ())
+        return register_compiled(
+            "lm_train_step/pp",
+            jax.jit(step, donate_argnums=(0,) if donate else ()),
+        )
 
     return compile_for
 

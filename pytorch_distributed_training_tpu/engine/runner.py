@@ -30,6 +30,7 @@ metric), optional bf16 compute (``training.dtype: bfloat16``).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -56,6 +57,7 @@ from ..optimizers import get_optimizer
 from ..parallel import initialize_distributed
 from ..schedulers import get_scheduler
 from ..utils import enable_compile_cache, make_deterministic, make_iter_dataloader
+from ..telemetry import Telemetry
 from . import fault
 from .checkpoint import Checkpointer
 from .elastic import ElasticCoordinator, PeerLostError
@@ -66,6 +68,7 @@ from .topology import (
     parse_batch,
     parse_elastic,
     parse_fault_tolerance,
+    parse_telemetry,
     parse_topology,
 )
 from .watchdog import StepWatchdog
@@ -99,6 +102,7 @@ class Runner:
         self.tb_writer_constructor = tb_writer_constructor
         self.iter: int = 0
         self.tb_writer = None
+        self._telemetry: Optional[Telemetry] = None
 
     def __call__(self):
         logger = logging.getLogger("Runner")
@@ -182,6 +186,10 @@ class Runner:
         # Elastic multi-host recovery keys (additive, off by default):
         # heartbeat coordinator + peer-loss guard (engine/elastic.py).
         parse_elastic(self, train_cfg)
+        # Unified telemetry keys (additive, in-memory layer on by default;
+        # files only when dir is set — telemetry/ package, README
+        # "Observability").
+        parse_telemetry(self, train_cfg)
         if self.fault_spec and not os.environ.get(fault.ENV_VAR):
             fault.install(self.fault_spec)
         self._injector = fault.get_injector()
@@ -363,6 +371,27 @@ class Runner:
                 self.elastic_timeout,
             )
 
+        # --- unified telemetry (telemetry/; README "Observability") ---------
+        # Built after the step path so its span recorder is live for the
+        # whole loop; the compiled step families already registered with the
+        # process-global jit-cache probe during path.build.
+        self._telemetry = Telemetry(
+            enabled=self.telemetry_enabled,
+            dir=self.telemetry_dir,
+            host=self.current_rank,
+            is_rank0=self.current_rank == 0,
+            snapshot_interval=self.telemetry_interval,
+            span_ring=self.telemetry_span_ring,
+            retrace_warn=self.telemetry_retrace_warn,
+            tb_writer=self.tb_writer,
+            use_tensorboard=self.telemetry_tensorboard,
+            capture_signal=self.telemetry_capture_signal,
+            capture_iters=self.telemetry_capture_iters,
+            capture_at_iter=self.telemetry_capture_at_iter,
+            capture_dir=self.telemetry_capture_dir,
+            logger=self.logger,
+        )
+
         # --- optional jax.profiler trace window (absent in reference; §5.1) --
         self.profiler = (
             TraceProfiler.from_config(train_cfg, self.logger)
@@ -427,8 +456,6 @@ class Runner:
                 logger=self.logger,
             )
 
-        import contextlib
-
         try:
             with self._preempt if self._preempt else contextlib.nullcontext():
                 self._train_loop(iter_generator, train_cfg)
@@ -443,6 +470,10 @@ class Runner:
                 self._watchdog.close()
             if self._elastic:
                 self._elastic.close()
+            # crash-path flush: buffered spans reach disk even when an
+            # exception is propagating (full close happens below on the
+            # clean path only)
+            self._telemetry.flush()
         if self.profiler:
             self.profiler.finalize()
         if self.checkpointer:
@@ -450,6 +481,9 @@ class Runner:
             self.checkpointer.close()
         self.train_loader.close()
         self.val_loader.close()
+        # final snapshot + human summary AFTER the checkpointer drained, so
+        # the last async write's stall/commit numbers are in the ledger
+        self._telemetry.close(step=self.iter)
 
     # ------------------------------------------------- pretrained ingestion
     def _load_torch_state_dict(self) -> dict:
@@ -675,6 +709,16 @@ class Runner:
             self.logger.error("watchdog stack dump:\n%s", "\n".join(dump))
         except Exception:  # the dump is best-effort diagnostics
             pass
+        tel = self._telemetry
+        if tel is not None and tel.enabled:
+            try:
+                # what the process was DOING when it stalled: the last phase
+                # spans + the full counter ledger (telemetry/runtime.py)
+                self.logger.error(
+                    "watchdog telemetry diagnostics:\n%s", tel.diagnostics()
+                )
+            except Exception:  # pragma: no cover - best-effort diagnostics
+                pass
         if self.watchdog_exit and self._preempt is not None:
             # reuse the eviction path: the loop checkpoints at the current
             # iteration and exits cleanly (multi-host agreement included)
@@ -699,6 +743,16 @@ class Runner:
         emergency step via the mesh-reshape-tolerant restore path)."""
         fault.bump("peer_lost")
         self.logger.error("elastic recovery: %s", e)
+        tel = self._telemetry
+        if tel is not None and tel.enabled:
+            try:
+                # same dump the watchdog makes: where the loop was when the
+                # peer's silence surfaced, plus every recovery counter
+                self.logger.error(
+                    "peer-loss telemetry diagnostics:\n%s", tel.diagnostics()
+                )
+            except Exception:  # pragma: no cover - best-effort diagnostics
+                pass
         if e.mid_step:
             # the in-flight step donated the previous state's buffers into
             # an unfinished computation — nothing consistent left to save
@@ -781,8 +835,14 @@ class Runner:
         return self._make_stream()
 
     def _train_loop(self, iter_generator, train_cfg):
+        tel = self._telemetry
+        # goodput accounting: a step at an iteration index we already passed
+        # is a post-rollback REPLAY (paid-again work, not fresh progress)
+        self._max_iter_seen = self.iter - 1
+        self._last_step_applied = True
         # --- the reference outer loop (:251-265), line for line -------------
         while self.iter < train_cfg["train_iters"]:
+            step_t0 = time.monotonic()
             if self._watchdog:
                 self._watchdog.step_started(self.iter)
             self._apply_step_faults()
@@ -791,26 +851,38 @@ class Runner:
                 # caught here, before this process enters any collective —
                 # the committed state is still saveable (emergency path)
                 self._elastic.check_peers()
-            g_img, g_label = next(iter_generator)
+            with tel.span("data_wait", step=self.iter):
+                g_img, g_label = next(iter_generator)
             if self._elastic is not None:
                 # elastic mode's documented per-step cost: the step runs
                 # under the peer-loss guard and is synced to completion, so
                 # a peer dying MID-collective turns an indefinite hang into
                 # a diagnosed PeerLostError within the heartbeat timeout
-                self._elastic.guard(
-                    self._synced_train_iter, g_img, g_label,
-                    what=f"train step {self.iter}",
-                )
+                with tel.span("step_dispatch", step=self.iter):
+                    self._elastic.guard(
+                        self._synced_train_iter, g_img, g_label,
+                        what=f"train step {self.iter}",
+                    )
             else:
-                self.train_iter(g_img, g_label)
+                with tel.span("step_dispatch", step=self.iter):
+                    self.train_iter(g_img, g_label)
             self._advance_pipeline()
             if self._watchdog:
                 self._watchdog.step_finished()
+            replayed = self.iter <= self._max_iter_seen
+            self._max_iter_seen = max(self._max_iter_seen, self.iter)
+            tel.note_step(
+                time.monotonic() - step_t0,
+                applied=self._last_step_applied,
+                replayed=replayed,
+            )
             if (
                 self.anomaly_enabled
                 and self._consec_anomalies >= self.anomaly_max_consec
             ):
+                rb_t0 = time.monotonic()
                 iter_generator = self._rollback(iter_generator, train_cfg)
+                tel.note_lost("rollback", time.monotonic() - rb_t0)
                 continue
             if self._preempt and self._globally_preempted():
                 self.logger.warning(
@@ -837,20 +909,24 @@ class Runner:
                 # the window is a bounded steady-state sample of train steps
                 if self.profiler:
                     self.profiler.stop(sync=self.state)
-                self.validate()
+                with tel.span("eval", step=self.iter):
+                    self.validate()
             if self.checkpointer and self.checkpointer.should_save(
                 self.iter, train_cfg["train_iters"]
             ):
                 if self.profiler:
                     self.profiler.stop(sync=self.state)
-                self.checkpointer.save(
-                    self.iter, self.state, extras=self._pipeline_extras()
-                )
+                with tel.span("ckpt_save", step=self.iter):
+                    self.checkpointer.save(
+                        self.iter, self.state, extras=self._pipeline_extras()
+                    )
                 if self.profiler:
                     # with checkpoint.async the write is in flight — block
                     # until it commits so the profiler window can't reopen
                     # over background checkpoint I/O
                     self.checkpointer.wait()
+            # retrace-probe poll + on-demand capture window + periodic export
+            tel.after_step(self.iter, sync=self.state)
             self.iter += 1
 
     def _globally_preempted(self) -> bool:
@@ -888,6 +964,14 @@ class Runner:
         g_label = jax.make_array_from_process_local_data(self._label_sharding, label)
         return g_img, g_label
 
+    def _tspan(self, kind: str, **extra):
+        """Telemetry span bound to the current iteration (no-op before the
+        telemetry facade is built — direct ``train_iter`` calls in tests)."""
+        tel = self._telemetry
+        if tel is None:
+            return contextlib.nullcontext()
+        return tel.span(kind, step=self.iter, **extra)
+
     def train_iter(self, g_img, g_label):
         """One training iteration on already-device-resident arrays."""
         train_cfg = self.global_cfg["training"]
@@ -900,7 +984,10 @@ class Runner:
             self.state, loss, gnorm, applied = self.train_step(
                 self.state, g_img, g_label, ref
             )
-            if float(applied) >= 0.5:
+            with self._tspan("device_block"):
+                applied_host = float(applied)
+            self._last_step_applied = applied_host >= 0.5
+            if self._last_step_applied:
                 self._gnorm_hist.append(float(gnorm))
                 self._consec_anomalies = 0
             else:
@@ -914,12 +1001,14 @@ class Runner:
                 )
         else:
             self.state, loss = self.train_step(self.state, g_img, g_label)
+            self._last_step_applied = True
         self._tput_iters += 1
 
         if self.iter % train_cfg["print_interval"] == 0:
             # loss is already replica-averaged in-graph; this is the only
             # host<->device sync of the steady-state loop (reference :280-284).
-            loss_val = float(loss)
+            with self._tspan("device_block"):
+                loss_val = float(loss)
             last_lr_group = self.scheduler.get_last_lr()
             now = time.monotonic()
             if self.iter == 0:

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
 from ..parallel.sequence import SEQUENCE_AXIS
+from ..telemetry.retrace import register_compiled
 from .steps import TrainState
 
 __all__ = ["build_lm_train_step", "build_lm_eval_step", "lm_loss_local"]
@@ -178,7 +179,7 @@ def build_lm_train_step(
                 ok.astype(jnp.float32),
             )
 
-        return train_step
+        return register_compiled("lm_train_step/sp_guarded", train_step)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, tokens, labels):
@@ -193,7 +194,7 @@ def build_lm_train_step(
             loss,
         )
 
-    return train_step
+    return register_compiled("lm_train_step/sp", train_step)
 
 
 def build_lm_eval_step(

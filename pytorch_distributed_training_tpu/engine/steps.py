@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..metrics import accuracy
 from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
+from ..telemetry.retrace import register_compiled
 
 __all__ = [
     "TrainState",
@@ -314,7 +315,7 @@ def build_train_step(
                 ok.astype(jnp.float32),
             )
 
-        return train_step
+        return register_compiled("train_step/gspmd_guarded", train_step)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, img, label):
@@ -332,7 +333,7 @@ def build_train_step(
             loss,
         )
 
-    return train_step
+    return register_compiled("train_step/gspmd", train_step)
 
 
 def build_eval_step(model, mesh: Mesh, input_norm=None):

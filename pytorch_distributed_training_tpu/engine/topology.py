@@ -32,6 +32,7 @@ __all__ = [
     "parse_batch",
     "parse_fault_tolerance",
     "parse_elastic",
+    "parse_telemetry",
 ]
 
 
@@ -545,3 +546,91 @@ def parse_elastic(r, train_cfg: dict) -> None:
                 "peer loss triggers a checkpoint-and-exit), or an explicit "
                 "training.elastic.dir"
             )
+
+
+def parse_telemetry(r, train_cfg: dict) -> None:
+    """Parse the additive ``training.telemetry`` section (ON by default —
+    the in-memory registry/goodput/retrace layer is near-free and files are
+    only written when ``dir`` is set) onto the runner (telemetry/):
+
+    .. code-block:: yaml
+
+        training:
+            telemetry:
+                enabled: true          # in-memory instruments + summary
+                dir: null              # spans_rank<k>.jsonl, snapshots.jsonl,
+                                       # profile/ captures land here
+                snapshot_interval: 100 # steps between JSONL/TB snapshots
+                span_ring: 256         # in-memory spans kept for diagnostics
+                tensorboard: true      # mirror snapshots into the TB writer
+                retrace_warn: 3        # compiles per fn before the storm warn
+                capture:               # on-demand jax.profiler window
+                    signal: SIGUSR2    # arm via kill -USR2 <pid> (null = off)
+                    n_iters: 5         # window length in steps
+                    at_iter: null      # config-triggered arm at this step
+                    dir: null          # default <telemetry.dir>/profile
+    """
+    tl = train_cfg.get("telemetry") or {}
+    unknown = set(tl) - {
+        "enabled", "dir", "snapshot_interval", "span_ring", "tensorboard",
+        "retrace_warn", "capture",
+    }
+    if unknown:
+        raise ValueError(
+            f"training.telemetry: unknown key(s) {sorted(unknown)} "
+            "(want enabled/dir/snapshot_interval/span_ring/tensorboard/"
+            "retrace_warn/capture)"
+        )
+    r.telemetry_enabled = bool(tl.get("enabled", True))
+    r.telemetry_dir = tl.get("dir")
+    r.telemetry_interval = int(tl.get("snapshot_interval", 100))
+    r.telemetry_span_ring = int(tl.get("span_ring", 256))
+    r.telemetry_tensorboard = bool(tl.get("tensorboard", True))
+    r.telemetry_retrace_warn = int(tl.get("retrace_warn", 3))
+    if r.telemetry_interval < 1:
+        raise ValueError(
+            "training.telemetry.snapshot_interval must be >= 1, got "
+            f"{r.telemetry_interval}"
+        )
+    if r.telemetry_span_ring < 1:
+        raise ValueError(
+            "training.telemetry.span_ring must be >= 1, got "
+            f"{r.telemetry_span_ring}"
+        )
+    if r.telemetry_retrace_warn < 1:
+        raise ValueError(
+            "training.telemetry.retrace_warn must be >= 1, got "
+            f"{r.telemetry_retrace_warn}"
+        )
+
+    cap = tl.get("capture") or {}
+    unknown = set(cap) - {"signal", "n_iters", "at_iter", "dir"}
+    if unknown:
+        raise ValueError(
+            f"training.telemetry.capture: unknown key(s) {sorted(unknown)} "
+            "(want signal/n_iters/at_iter/dir)"
+        )
+    from ..telemetry.capture import parse_signal
+
+    # an explicit capture section arms the signal path by default; without
+    # one nothing is installed (signal handlers are process-global state)
+    r.telemetry_capture_signal = (
+        parse_signal(cap.get("signal", "SIGUSR2")) if cap else None
+    )
+    r.telemetry_capture_iters = int(cap.get("n_iters", 5))
+    r.telemetry_capture_at_iter = (
+        int(cap["at_iter"]) if cap.get("at_iter") is not None else None
+    )
+    r.telemetry_capture_dir = cap.get("dir")
+    if r.telemetry_capture_iters < 1:
+        raise ValueError(
+            "training.telemetry.capture.n_iters must be >= 1, got "
+            f"{r.telemetry_capture_iters}"
+        )
+    if cap and not (
+        r.telemetry_capture_dir or r.telemetry_dir
+    ):
+        raise ValueError(
+            "training.telemetry.capture needs somewhere to write traces: "
+            "set training.telemetry.dir or training.telemetry.capture.dir"
+        )

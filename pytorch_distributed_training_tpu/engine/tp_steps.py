@@ -30,6 +30,7 @@ from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
 from ..parallel.sequence import SEQUENCE_AXIS
 from ..parallel.tensor import tp_state_shardings
+from ..telemetry.retrace import register_compiled
 from .steps import TrainState
 
 
@@ -184,11 +185,14 @@ def build_tp_lm_train_step(
         state_sh = tp_state_shardings(state, mesh, zero=zero)
         tok_sh = NamedSharding(mesh, _token_spec(mesh))
         rep = NamedSharding(mesh, P())
-        return jax.jit(
-            step,
-            in_shardings=(state_sh, tok_sh, tok_sh),
-            out_shardings=(state_sh, rep),
-            donate_argnums=(0,) if donate else (),
+        return register_compiled(
+            "lm_train_step/tp",
+            jax.jit(
+                step,
+                in_shardings=(state_sh, tok_sh, tok_sh),
+                out_shardings=(state_sh, rep),
+                donate_argnums=(0,) if donate else (),
+            ),
         )
 
     return compile_for

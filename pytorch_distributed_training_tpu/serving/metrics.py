@@ -4,6 +4,19 @@ Latency is recorded per REQUEST (enqueue -> result set), so batching
 delay is included — the number a client actually observes.  Throughput
 counts work items (images for classification, generated tokens for LM)
 over the window from the first to the last recorded request.
+
+Storage is BOUNDED (telemetry/registry.py): per-request latencies,
+batch sizes, and generated-token lengths land in Algorithm-R reservoir
+histograms instead of the lists that previously grew one float per
+request forever under sustained traffic.  Counts, sums, and means in the
+snapshot stay exact (tracked outside the reservoir); the reported
+percentiles are estimates of the TRUE stream percentiles once the stream
+exceeds the reservoir (and exact below it, which keeps the snapshot
+byte-stable for short runs and the existing tests).
+
+Instruments live in a PRIVATE :class:`MetricsRegistry` (not the process
+one): each engine owns its counts, and two engines in one process must
+not share a ledger.
 """
 from __future__ import annotations
 
@@ -11,9 +24,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-import numpy as np
+from ..telemetry.registry import MetricsRegistry
 
 __all__ = ["ServingMetrics"]
+
+# reservoir per distribution: big enough that p99 of a uniform sample is a
+# tight estimate, small enough to cap memory at a few KB per engine
+_RESERVOIR = 2048
 
 
 class ServingMetrics:
@@ -21,26 +38,24 @@ class ServingMetrics:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._latencies_ms: List[float] = []
-        self._batch_sizes: List[int] = []
+        self._registry = MetricsRegistry()
+        self._latency_ms = self._registry.histogram("latency_ms", _RESERVOIR)
+        self._batch_size = self._registry.histogram("batch_size", _RESERVOIR)
+        self._gen_len = self._registry.histogram("gen_len", _RESERVOIR)
         self._items = 0
         self._first_t: Optional[float] = None
         self._last_t: Optional[float] = None
         self._max_depth = 0
-        # LM phase split (round 6): per-request generated-token counts plus
-        # accumulated prefill/decode device seconds and prompt tokens, so
-        # the snapshot can report prefill vs decode tokens/s separately
-        self._gen_lens: List[int] = []
+        # LM phase split (round 6): accumulated prefill/decode device seconds
+        # and prompt tokens, so the snapshot can report prefill vs decode
+        # tokens/s separately
         self._prompt_tokens = 0
         self._prefill_s = 0.0
         self._decode_s = 0.0
-        # degradation/recovery event counters (timeouts, sheds, ...)
-        self._counters: Dict[str, int] = {}
 
     def incr(self, name: str, n: int = 1) -> None:
         """Bump a named degradation counter (e.g. ``timeouts``, ``sheds``)."""
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + int(n)
+        self._registry.counter(name).inc(n)
 
     def record_batch(
         self,
@@ -60,17 +75,18 @@ class ServingMetrics:
         phase wall times.
         """
         now = time.monotonic()
+        for t0 in enqueued_ats:
+            self._latency_ms.observe((now - t0) * 1000.0)
+        self._batch_size.observe(len(enqueued_ats))
+        if gen_lens is not None:
+            for g in gen_lens:
+                self._gen_len.observe(int(g))
         with self._lock:
-            for t0 in enqueued_ats:
-                self._latencies_ms.append((now - t0) * 1000.0)
-            self._batch_sizes.append(len(enqueued_ats))
             self._items += n_items
             if self._first_t is None:
                 self._first_t = now
             self._last_t = now
             self._max_depth = max(self._max_depth, queue_depth)
-            if gen_lens is not None:
-                self._gen_lens.extend(int(g) for g in gen_lens)
             self._prompt_tokens += int(prompt_tokens)
             self._prefill_s += float(prefill_s)
             self._decode_s += float(decode_s)
@@ -81,9 +97,10 @@ class ServingMetrics:
 
     def snapshot(self) -> Dict[str, float]:
         """Aggregate view: p50/p99 latency, items/sec, batch occupancy."""
+        lat = self._latency_ms.snapshot()
+        sizes = self._batch_size.snapshot()
+        gen = self._gen_len.snapshot()
         with self._lock:
-            lat = np.asarray(self._latencies_ms, np.float64)
-            sizes = np.asarray(self._batch_sizes, np.float64)
             span = (
                 (self._last_t - self._first_t)
                 if self._first_t is not None and self._last_t > self._first_t
@@ -91,32 +108,30 @@ class ServingMetrics:
             )
             items = self._items
             depth = self._max_depth
-            gen = np.asarray(self._gen_lens, np.float64)
             prompt_tokens = self._prompt_tokens
             prefill_s = self._prefill_s
             decode_s = self._decode_s
-            counters = dict(self._counters)
         out = {
-            "requests": int(lat.size),
-            "batches": int(sizes.size),
+            "requests": int(lat["count"]),
+            "batches": int(sizes["count"]),
             "items": int(items),
             "max_queue_depth": int(depth),
         }
-        out.update(counters)
-        if lat.size:
-            out["latency_ms_p50"] = float(np.percentile(lat, 50))
-            out["latency_ms_p99"] = float(np.percentile(lat, 99))
-            out["latency_ms_mean"] = float(lat.mean())
-        if sizes.size:
-            out["batch_size_mean"] = float(sizes.mean())
+        out.update({k: v for k, v in self._registry.counters().items() if v})
+        if lat["count"]:
+            out["latency_ms_p50"] = float(lat["p50"])
+            out["latency_ms_p99"] = float(lat["p99"])
+            out["latency_ms_mean"] = float(lat["mean"])
+        if sizes["count"]:
+            out["batch_size_mean"] = float(sizes["mean"])
         # open-loop throughput needs a time span; a single flush has none,
         # so fall back to unreported rather than divide-by-zero noise
         if span > 0:
             out["items_per_sec"] = float(items / span)
-        if gen.size:
-            out["gen_tokens"] = int(gen.sum())
-            out["gen_len_mean"] = float(gen.mean())
-            out["gen_len_p50"] = float(np.percentile(gen, 50))
+        if gen["count"]:
+            out["gen_tokens"] = int(gen["sum"])
+            out["gen_len_mean"] = float(gen["mean"])
+            out["gen_len_p50"] = float(gen["p50"])
             # phase rates: prefill consumes real prompt tokens, decode emits
             # generated tokens (token 0 is sampled by the prefill program —
             # one token per request of attribution noise, documented rather
@@ -124,7 +139,7 @@ class ServingMetrics:
             if prefill_s > 0:
                 out["prefill_tokens_per_sec"] = float(prompt_tokens / prefill_s)
             if decode_s > 0:
-                out["decode_tokens_per_sec"] = float(gen.sum() / decode_s)
+                out["decode_tokens_per_sec"] = float(gen["sum"] / decode_s)
         return out
 
     def log_summary(self, logger, prefix: str = "serving") -> Dict[str, float]:

@@ -1,0 +1,56 @@
+"""Unified telemetry: metrics registry, trace spans, goodput, capture.
+
+The single observability surface for the whole system (README
+"Observability").  Five subsystems' scattered counters and timers flow
+through one process-global :class:`MetricsRegistry`; host-phase
+:func:`span` context managers attribute each step's wall-clock; a
+:class:`GoodputTracker` splits it into productive vs recovery time; the
+jit-cache probe (:func:`register_compiled`) counts XLA compilations per
+step function and flags retrace storms; and :class:`OnDemandProfiler`
+opens a bounded ``jax.profiler`` window on SIGUSR2 or at a configured
+iteration.  Everything exports through three sinks (TensorBoard / JSONL
+snapshot / human summary table) behind the :class:`Telemetry` facade the
+Runner drives.
+
+Core modules (registry, spans, goodput, retrace) are stdlib-only so the
+data pipeline and serving stack can import them without pulling JAX in.
+"""
+from .capture import OnDemandProfiler, parse_signal
+from .goodput import GoodputTracker
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from .retrace import JitCacheProbe, get_probe, register_compiled
+from .runtime import Telemetry
+from .sinks import JsonlSink, LogSink, Sink, TensorBoardSink, summary_table
+from .spans import SpanRecorder, get_recorder, set_recorder, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "GoodputTracker",
+    "Histogram",
+    "JitCacheProbe",
+    "JsonlSink",
+    "LogSink",
+    "MetricsRegistry",
+    "OnDemandProfiler",
+    "Sink",
+    "SpanRecorder",
+    "Telemetry",
+    "TensorBoardSink",
+    "get_probe",
+    "get_recorder",
+    "get_registry",
+    "parse_signal",
+    "register_compiled",
+    "reset_registry",
+    "set_recorder",
+    "span",
+    "summary_table",
+]

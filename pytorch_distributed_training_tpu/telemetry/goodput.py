@@ -1,0 +1,104 @@
+"""Goodput accounting: how much wall-clock actually advanced training.
+
+The fault/elastic layers recover from anomalies, hangs, dead workers and
+dead peers — but recovery costs steps.  This tracker splits the training
+loop's wall time into:
+
+- **productive**: steps that advanced the optimizer to a NEW iteration
+  (applied, never seen before);
+- **replay**: steps re-run after a rollback (``iter`` at or below the
+  furthest iteration previously reached — the same batches again);
+- **wasted**: steps the anomaly guard skipped (state bitwise untouched);
+- **lost buckets** by kind: rollback restores, restart/re-init, and
+  whatever else a caller bills via :meth:`note_lost`.
+
+``goodput_ratio = productive / (productive + replay + wasted + lost)`` is
+the single number a long chaotic run is judged by — the per-step
+scaling-efficiency accounting the minutes-scale ImageNet recipes
+(PAPERS.md 1811.05233, 1903.12650) are built on.  Recompile time is
+tracked separately by the jit-cache probe (retrace.py): XLA compiles
+inside a step are invisible to host timers except as a slow step, so the
+probe counts them rather than pretending to time them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["GoodputTracker"]
+
+
+class GoodputTracker:
+    """Thread-safe productive-vs-lost wall-time ledger."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._productive_s = 0.0
+        self._replay_s = 0.0
+        self._wasted_s = 0.0
+        self._lost: Dict[str, float] = {}
+        self._steps = 0
+        self._replayed_steps = 0
+        self._wasted_steps = 0
+
+    def note_step(
+        self, seconds: float, applied: bool = True, replayed: bool = False
+    ) -> None:
+        """Bill one loop iteration's wall time.
+
+        ``applied=False`` marks an anomaly-guard skip (the step ran but
+        changed nothing); ``replayed=True`` marks a post-rollback re-run.
+        A replayed skip bills as replay (the rollback already owns the
+        waste).
+        """
+        s = float(seconds)
+        with self._lock:
+            self._steps += 1
+            if replayed:
+                self._replayed_steps += 1
+                self._replay_s += s
+            elif not applied:
+                self._wasted_steps += 1
+                self._wasted_s += s
+            else:
+                self._productive_s += s
+
+    def note_lost(self, kind: str, seconds: float) -> None:
+        """Bill non-step recovery time (``rollback``, ``restart``, ...)."""
+        with self._lock:
+            self._lost[kind] = self._lost.get(kind, 0.0) + float(seconds)
+
+    def ratio(self) -> Optional[float]:
+        with self._lock:
+            total = (
+                self._productive_s + self._replay_s + self._wasted_s
+                + sum(self._lost.values())
+            )
+            if total <= 0.0:
+                return None
+            return self._productive_s / total
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lost_total = sum(self._lost.values())
+            total = self._productive_s + self._replay_s + self._wasted_s + lost_total
+            out = {
+                "steps": self._steps,
+                "replayed_steps": self._replayed_steps,
+                "skipped_steps": self._wasted_steps,
+                "productive_s": round(self._productive_s, 6),
+                "replay_s": round(self._replay_s, 6),
+                "skipped_s": round(self._wasted_s, 6),
+                "lost_s": round(lost_total, 6),
+            }
+            for kind, s in sorted(self._lost.items()):
+                out[f"lost_{kind}_s"] = round(s, 6)
+            if total > 0.0:
+                out["goodput_ratio"] = round(self._productive_s / total, 6)
+            return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._productive_s = self._replay_s = self._wasted_s = 0.0
+            self._lost.clear()
+            self._steps = self._replayed_steps = self._wasted_steps = 0

@@ -1,0 +1,283 @@
+"""Process-global metrics registry: counters, gauges, bounded histograms.
+
+One ledger for every subsystem's observability numbers.  Before this
+module each layer grew its own store — ``engine/fault.py`` had a module
+``Counter``, ``serving/metrics.py`` kept unbounded per-request lists,
+``engine/checkpoint.py`` and ``data/worker_pool.py`` carried loose ints —
+so "where did the wall-clock go" required reading five snapshots with five
+schemas.  Now every counter flows through a :class:`MetricsRegistry`
+(``tests/test_marker_convention.py`` statically rejects new ad-hoc counter
+dicts outside ``telemetry/``).
+
+Design constraints, in order:
+
+- **Import-light** (stdlib only): the data pipeline, serving stack, and
+  fault layer consult the registry without pulling JAX in — the same rule
+  ``engine/fault.py`` already follows.
+- **Low overhead**: an ``inc`` is one lock + one int add; a histogram
+  ``observe`` is one lock + O(1) reservoir bookkeeping.  Nothing allocates
+  per call on the steady path.
+- **Bounded memory**: histograms keep an Algorithm-R reservoir (uniform
+  sample of everything observed) plus EXACT count/sum/min/max, so
+  percentiles stay statistically stable and means stay exact no matter how
+  long the process runs — the fix for the serving metrics lists that grew
+  forever under sustained traffic.
+
+The process-global registry lives behind :func:`get_registry`; subsystems
+that need *instance-local* semantics (one :class:`ServingMetrics` per
+engine) instantiate their own :class:`MetricsRegistry`.
+"""
+from __future__ import annotations
+
+import math
+import random
+import threading
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "reset_registry",
+]
+
+
+class Counter:
+    """Monotonic integer counter (thread-safe)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += int(n)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-write-wins float (thread-safe); tracks the running max too."""
+
+    __slots__ = ("name", "_value", "_max", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            if self._value > self._max:
+                self._max = self._value
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+            self._max = 0.0
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile of an ascending list (numpy's default
+    method, so snapshots keep byte-stable values across the serving-metrics
+    migration off ``np.percentile``)."""
+    n = len(sorted_vals)
+    if n == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (n - 1)
+    lo = int(math.floor(pos))
+    frac = pos - lo
+    hi = min(lo + 1, n - 1)
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+class Histogram:
+    """Bounded-reservoir histogram: exact count/sum/min/max, sampled tails.
+
+    Algorithm R keeps a uniform sample of the full observation stream in
+    ``reservoir_size`` slots, so p50/p95/p99 estimate the TRUE stream
+    percentiles (not a sliding window's) under any volume, while the moments
+    the snapshot reports as exact (count, sum, mean, min, max) ARE exact.
+    The RNG is seeded per-histogram so snapshots are reproducible.
+    """
+
+    __slots__ = (
+        "name", "reservoir_size", "_sample", "_count", "_sum", "_min",
+        "_max", "_rng", "_lock",
+    )
+
+    def __init__(self, name: str, reservoir_size: int = 1024):
+        if int(reservoir_size) < 1:
+            raise ValueError(
+                f"histogram reservoir_size must be >= 1, got {reservoir_size}"
+            )
+        self.name = name
+        self.reservoir_size = int(reservoir_size)
+        self._sample: List[float] = []
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._rng = random.Random(0x5EED ^ (hash(name) & 0xFFFFFFFF))
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+            if len(self._sample) < self.reservoir_size:
+                self._sample.append(v)
+            else:
+                # Algorithm R: slot i < k with probability k/count — every
+                # observation ever made has equal odds of being in the sample
+                i = self._rng.randrange(self._count)
+                if i < self.reservoir_size:
+                    self._sample[i] = v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._sample:
+                return None
+            return _percentile(sorted(self._sample), q)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0}
+            s = sorted(self._sample)
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "mean": self._sum / self._count,
+                "min": self._min,
+                "max": self._max,
+                "p50": _percentile(s, 50),
+                "p95": _percentile(s, 95),
+                "p99": _percentile(s, 99),
+            }
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._sample.clear()
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+
+class MetricsRegistry:
+    """Named instrument store; instruments are created on first use."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, object] = {}
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, *args)
+                self._instruments[name] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, requested {cls.__name__}"
+                )
+            return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, reservoir_size: int = 1024) -> Histogram:
+        return self._get(name, Histogram, reservoir_size)
+
+    # ------------------------------------------------------------- snapshots
+    def counters(self) -> Dict[str, int]:
+        """All counter values (the ``fault.counters()`` compatibility view)."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        return {i.name: i.value for i in insts if isinstance(i, Counter)}
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Full structured view: one sub-dict per instrument family."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        out: Dict[str, dict] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for i in insts:
+            if isinstance(i, Counter):
+                out["counters"][i.name] = i.value
+            elif isinstance(i, Gauge):
+                out["gauges"][i.name] = {"value": i.value, "max": i.max}
+            elif isinstance(i, Histogram):
+                out["histograms"][i.name] = i.snapshot()
+        return out
+
+    def reset(self) -> None:
+        """Zero every instrument (kept registered — object identity is part
+        of the API: call sites cache ``registry.counter(name)``)."""
+        with self._lock:
+            insts = list(self._instruments.values())
+        for i in insts:
+            i._reset()
+
+
+# ---------------------------------------------------------- process-global
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry (every subsystem's shared ledger)."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+def reset_registry() -> None:
+    """Zero the process registry (test/bench isolation hook)."""
+    get_registry().reset()

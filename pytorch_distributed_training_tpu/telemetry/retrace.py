@@ -1,0 +1,130 @@
+"""Jit-cache retrace probe: count XLA compilations per compiled function.
+
+A retrace storm — a jitted step recompiling every call because a Python
+scalar, a changing shape, or a fresh closure rides into it as a new
+signature — looks exactly like "training got 100x slower" from the host
+timers.  The probe makes it attributable: every step builder registers its
+compiled function under a stable name, and a per-step poll reads the
+function's executable-cache size (``_cache_size()`` on jax's jit wrapper
+— the count of distinct traced signatures, i.e. compilations).  Deltas
+flow into the global registry as ``compiles/<name>`` counters, and a
+function whose RE-compile count (compiles beyond the first) crosses
+``warn_threshold`` logs one loud storm warning per new compile.
+
+Registered functions are held by weakref so the probe never extends the
+life of a step (and the closures over model/optimizer inside it) past its
+builder's caller.  Functions without ``_cache_size`` (older jax, wrapped
+callables) register as inert entries — the probe degrades to "no data",
+never to an error.  Import-light: nothing here imports jax.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import weakref
+from typing import Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = ["JitCacheProbe", "get_probe", "register_compiled"]
+
+
+class JitCacheProbe:
+    """Registry of compiled fns, polled for executable-cache growth."""
+
+    def __init__(self, warn_threshold: int = 3, logger=None):
+        self.warn_threshold = int(warn_threshold)
+        self._logger = logger or logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        # name -> (weakref to fn, compiles already accounted, compiles warned)
+        self._entries: Dict[str, list] = {}
+
+    def register(self, name: str, fn):
+        """Track ``fn``'s compile cache under ``name``; returns ``fn`` so
+        builders can register in the return statement.  A name whose prior
+        registrant is still alive gets a ``#k`` suffix (bench loops build
+        the same family repeatedly)."""
+        with self._lock:
+            key = name
+            k = 1
+            while key in self._entries and self._entries[key][0]() is not None:
+                k += 1
+                key = f"{name}#{k}"
+            self._entries[key] = [weakref.ref(fn), 0, 0]
+        return fn
+
+    @staticmethod
+    def _cache_size(fn) -> Optional[int]:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    def poll(self, registry: Optional[MetricsRegistry] = None) -> Dict[str, int]:
+        """Account new compilations since the last poll; returns the current
+        total compile count per live registered fn."""
+        reg = registry if registry is not None else get_registry()
+        totals: Dict[str, int] = {}
+        with self._lock:
+            items = list(self._entries.items())
+        for name, entry in items:
+            fn = entry[0]()
+            if fn is None:
+                continue
+            size = self._cache_size(fn)
+            if size is None:
+                continue
+            totals[name] = size
+            delta = size - entry[1]
+            if delta <= 0:
+                continue
+            entry[1] = size
+            reg.counter(f"compiles/{name}").inc(delta)
+            recompiles = size - 1
+            if recompiles >= self.warn_threshold and size > entry[2]:
+                entry[2] = size
+                self._logger.warning(
+                    "RETRACE STORM: %s has compiled %d times (%d retraces, "
+                    "threshold %d) — a step input is changing "
+                    "signature/shape every call; see compiles/%s in the "
+                    "telemetry snapshot",
+                    name, size, recompiles, self.warn_threshold, name,
+                )
+        return totals
+
+    def snapshot(self) -> Dict[str, int]:
+        """Current compile counts without mutating warning state."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            items = list(self._entries.items())
+        for name, entry in items:
+            fn = entry[0]()
+            if fn is None:
+                continue
+            size = self._cache_size(fn)
+            if size is not None:
+                out[name] = size
+        return out
+
+
+# ---------------------------------------------------------- process-global
+_LOCK = threading.Lock()
+_PROBE: Optional[JitCacheProbe] = None
+
+
+def get_probe() -> JitCacheProbe:
+    global _PROBE
+    if _PROBE is None:
+        with _LOCK:
+            if _PROBE is None:
+                _PROBE = JitCacheProbe()
+    return _PROBE
+
+
+def register_compiled(name: str, fn):
+    """Register a compiled fn with the process probe (builders call this
+    in their return path); returns ``fn``."""
+    return get_probe().register(name, fn)

@@ -1,0 +1,137 @@
+"""Telemetry export sinks: one snapshot schema, three surfaces.
+
+Every ``snapshot_interval`` steps (and once at end of run) the Telemetry
+facade assembles one structured snapshot — counters, gauges, histogram
+percentiles, goodput split, compile counts — and hands it to each
+configured sink:
+
+- :class:`TensorBoardSink`: scalars onto the Runner's existing rank-0
+  writer under ``telemetry/…`` (counters, gauges, histogram p50/p95/p99,
+  goodput ratio) so the dashboards people already watch gain the new
+  numbers for free.
+- :class:`JsonlSink`: the full snapshot, one JSON object per line, into
+  ``snapshots.jsonl`` under the telemetry dir — the machine-readable
+  record a regression hunt greps.  Written by rank 0 only (the registry is
+  per-process; cross-host aggregation follows the ``logger/`` design:
+  per-host span files + the rank-0 funnelled summary, not a distributed
+  collector).
+- :class:`LogSink`: the human ``summary()`` table through the process
+  logger — which in the Runner carries a ``QueueHandler`` into the
+  multiprocess log funnel (``logger/``), so the table lands in the same
+  rank-0 aggregated log file as everything else.
+
+``summary_table`` is also called directly by the watchdog-hang and
+peer-loss diagnostics.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Sink", "TensorBoardSink", "JsonlSink", "LogSink", "summary_table"]
+
+
+class Sink:
+    """Export interface: receives each periodic snapshot."""
+
+    def emit(self, snapshot: Dict, step: Optional[int]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+class TensorBoardSink(Sink):
+    """Scalars onto an existing SummaryWriter-compatible object."""
+
+    def __init__(self, writer, prefix: str = "telemetry"):
+        self._writer = writer
+        self._prefix = prefix
+
+    def emit(self, snapshot: Dict, step: Optional[int]) -> None:
+        if self._writer is None or step is None:
+            return
+        p = self._prefix
+        for name, v in snapshot.get("counters", {}).items():
+            self._writer.add_scalar(f"{p}/counters/{name}", v, step)
+        for name, g in snapshot.get("gauges", {}).items():
+            self._writer.add_scalar(f"{p}/gauges/{name}", g["value"], step)
+        for name, h in snapshot.get("histograms", {}).items():
+            if h.get("count"):
+                for q in ("p50", "p95", "p99"):
+                    self._writer.add_scalar(f"{p}/{name}/{q}", h[q], step)
+        ratio = snapshot.get("goodput", {}).get("goodput_ratio")
+        if ratio is not None:
+            self._writer.add_scalar(f"{p}/goodput_ratio", ratio, step)
+        # the writer flushes on its own schedule; no flush here
+
+
+class JsonlSink(Sink):
+    """Append each snapshot as one JSON line (machine-readable record)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._file = open(path, "a")
+
+    def emit(self, snapshot: Dict, step: Optional[int]) -> None:
+        rec = {"step": step, "wall": round(time.time(), 3)}
+        rec.update(snapshot)
+        self._file.write(json.dumps(rec) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class LogSink(Sink):
+    """The human summary table through the (funnelled) process logger."""
+
+    def __init__(self, logger: Optional[logging.Logger] = None):
+        self._logger = logger or logging.getLogger(__name__)
+
+    def emit(self, snapshot: Dict, step: Optional[int]) -> None:
+        self._logger.info(
+            "telemetry summary (step %s):\n%s", step, summary_table(snapshot)
+        )
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+def summary_table(snapshot: Dict) -> str:
+    """Render a snapshot as an aligned two-column table (the ``summary()``
+    surface printed at end of run and on watchdog/peer-loss dumps)."""
+    rows: List[tuple] = []
+    gp = snapshot.get("goodput", {})
+    if gp:
+        ratio = gp.get("goodput_ratio")
+        rows.append((
+            "goodput.ratio", f"{ratio:.4f}" if ratio is not None else "n/a"
+        ))
+        for k in ("steps", "replayed_steps", "skipped_steps"):
+            if gp.get(k):
+                rows.append((f"goodput.{k}", _fmt(gp[k])))
+        for k, v in gp.items():
+            if k.endswith("_s") and v:
+                rows.append((f"goodput.{k}", _fmt(v)))
+    for name, v in sorted(snapshot.get("counters", {}).items()):
+        if v:
+            rows.append((f"counter.{name}", _fmt(v)))
+    for name, g in sorted(snapshot.get("gauges", {}).items()):
+        rows.append((f"gauge.{name}", f"{g['value']:.3f} (max {g['max']:.3f})"))
+    for name, h in sorted(snapshot.get("histograms", {}).items()):
+        if h.get("count"):
+            rows.append((
+                f"hist.{name}",
+                f"n={h['count']} mean={h['mean']:.3f} p50={h['p50']:.3f} "
+                f"p95={h['p95']:.3f} p99={h['p99']:.3f}",
+            ))
+    if not rows:
+        return "  (no telemetry recorded)"
+    width = max(len(k) for k, _ in rows)
+    return "\n".join(f"  {k.ljust(width)}  {v}" for k, v in rows)

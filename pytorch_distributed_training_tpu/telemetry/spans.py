@@ -1,0 +1,143 @@
+"""Host-phase trace spans: where each step's wall-clock actually went.
+
+A span brackets one host-visible phase of the training loop — data wait,
+step dispatch, device block, eval, checkpoint snapshot vs async write,
+elastic guard window — with a context manager:
+
+    with spans.span("data_wait", step=it):
+        batch = next(stream)
+
+Every span records monotonic start, wall-clock start, duration, kind,
+step, and thread, and lands in two places:
+
+- a bounded in-memory ring (always on, O(1) per span) that the watchdog
+  and peer-loss diagnostics dump — a hang report says what the process was
+  DOING, not just that it stopped;
+- optionally a per-host JSONL file (``spans_rank<k>.jsonl`` under the
+  telemetry dir), append-buffered and flushed every ``flush_every`` spans
+  so the file cost stays off the per-span path.
+
+Per-host files rather than one shared file: hosts only share a filesystem
+by accident, and interleaved writers corrupt JSONL.  Rank 0's periodic
+registry snapshot (sinks.py) is the aggregated view.
+
+The module keeps one *current* recorder that the free function
+:func:`span` uses, so deep call sites (``engine/checkpoint.py``'s writer
+thread, ``engine/elastic.py``'s guard) emit spans without threading a
+handle through every constructor — the same pattern as the fault-counter
+ledger.  The default recorder is ring-only; the Runner swaps in its
+configured recorder for the duration of the run.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["SpanRecorder", "get_recorder", "set_recorder", "span"]
+
+
+class SpanRecorder:
+    """Thread-safe span sink: bounded ring + optional buffered JSONL file."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        ring: int = 256,
+        host: int = 0,
+        flush_every: int = 64,
+    ):
+        self.path = path
+        self.host = int(host)
+        self._ring: deque = deque(maxlen=max(int(ring), 1))
+        self._buf: List[str] = []
+        self._flush_every = max(int(flush_every), 1)
+        self._lock = threading.Lock()
+        self._file = open(path, "a") if path else None
+        self.enabled = True
+
+    @contextlib.contextmanager
+    def span(self, kind: str, step: Optional[int] = None, **extra):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        wall = time.time()
+        try:
+            yield
+        finally:
+            self._record(kind, step, t0, wall, time.monotonic() - t0, extra)
+
+    def _record(self, kind, step, t0, wall, dur_s, extra) -> None:
+        rec: Dict = {
+            "kind": kind,
+            "step": step,
+            "host": self.host,
+            "t": round(t0, 6),
+            "wall": round(wall, 3),
+            "ms": round(dur_s * 1e3, 3),
+            "thread": threading.current_thread().name,
+        }
+        if extra:
+            rec.update(extra)
+        with self._lock:
+            self._ring.append(rec)
+            if self._file is not None:
+                self._buf.append(json.dumps(rec))
+                if len(self._buf) >= self._flush_every:
+                    self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf and self._file is not None:
+            self._file.write("\n".join(self._buf) + "\n")
+            self._file.flush()
+        self._buf.clear()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def recent(self, n: Optional[int] = None) -> List[Dict]:
+        """Last ``n`` spans, oldest first (diagnostics payload)."""
+        with self._lock:
+            items = list(self._ring)
+        return items if n is None else items[-int(n):]
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+# ----------------------------------------------------------- current recorder
+_LOCK = threading.Lock()
+_RECORDER: Optional[SpanRecorder] = None
+
+
+def get_recorder() -> SpanRecorder:
+    """The current recorder (a ring-only default until a run installs one)."""
+    global _RECORDER
+    if _RECORDER is None:
+        with _LOCK:
+            if _RECORDER is None:
+                _RECORDER = SpanRecorder()
+    return _RECORDER
+
+
+def set_recorder(recorder: Optional[SpanRecorder]) -> SpanRecorder:
+    """Install ``recorder`` as the process's current one (None restores a
+    fresh ring-only default); returns the recorder now in effect."""
+    global _RECORDER
+    with _LOCK:
+        _RECORDER = recorder if recorder is not None else SpanRecorder()
+        return _RECORDER
+
+
+def span(kind: str, step: Optional[int] = None, **extra):
+    """Record a phase span on the current recorder (context manager)."""
+    return get_recorder().span(kind, step=step, **extra)

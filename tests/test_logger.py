@@ -1,0 +1,110 @@
+"""Multi-process log funnel (logger/MultiProcessLoggerListener).
+
+The listener is the rank-0 side of the logging design every other
+subsystem leans on (telemetry LogSink, worker pools, elastic respawn):
+children put LogRecords on a multiprocessing queue via ``QueueHandler``
+and a ``QueueListener`` thread drains them into the real handlers.
+
+Kept import-light on purpose: the spawn start method re-imports this
+module in the child, so nothing heavy (no jax) at module level.
+"""
+import logging
+import logging.handlers
+import multiprocessing
+
+import pytest
+
+from pytorch_distributed_training_tpu.logger import MultiProcessLoggerListener
+
+
+class ListHandler(logging.Handler):
+    """Sink handler capturing records in-process for assertions."""
+
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def _make_listener():
+    sink = ListHandler()
+
+    def constructor():
+        logger = logging.getLogger("test-mp-funnel")
+        logger.setLevel(logging.INFO)
+        logger.handlers = [sink]
+        logger.propagate = False
+        return logger
+
+    return MultiProcessLoggerListener(constructor, "spawn"), sink
+
+
+def _child_log(queue, messages):
+    """Module-level so the spawn child can unpickle it by qualified name."""
+    logger = logging.getLogger("mp-child")
+    logger.setLevel(logging.INFO)
+    logger.handlers = [logging.handlers.QueueHandler(queue)]
+    logger.propagate = False
+    for msg in messages:
+        logger.info(msg)
+
+
+def test_child_process_records_reach_sink_handlers():
+    listener, sink = _make_listener()
+    try:
+        ctx = multiprocessing.get_context("spawn")
+        msgs = [f"child record {i}" for i in range(5)]
+        p = ctx.Process(target=_child_log, args=(listener.queue, msgs))
+        p.start()
+        p.join(timeout=60)
+        assert p.exitcode == 0
+    finally:
+        listener.stop()  # stop() drains the queue before closing it
+    got = [r.getMessage() for r in sink.records]
+    assert got == msgs  # all records, original order, none dropped
+
+
+def test_stop_drains_pending_records():
+    listener, sink = _make_listener()
+    qh = logging.handlers.QueueHandler(listener.queue)
+    producer = logging.getLogger("test-mp-producer")
+    producer.setLevel(logging.INFO)
+    producer.handlers = [qh]
+    producer.propagate = False
+    n = 200
+    for i in range(n):
+        producer.info("pending %d", i)
+    # no sleep/poll: stop() itself must flush whatever is still queued
+    listener.stop()
+    assert len(sink.records) == n
+    assert sink.records[-1].getMessage() == f"pending {n - 1}"
+
+
+def test_double_stop_is_safe():
+    listener, _ = _make_listener()
+    listener.stop()
+    listener.stop()  # second stop: no raise, no hang on the closed queue
+
+
+def test_respects_handler_level():
+    listener, sink = _make_listener()
+    sink.setLevel(logging.ERROR)
+    qh = logging.handlers.QueueHandler(listener.queue)
+    producer = logging.getLogger("test-mp-levels")
+    producer.setLevel(logging.INFO)
+    producer.handlers = [qh]
+    producer.propagate = False
+    producer.info("drop me")
+    producer.error("keep me")
+    listener.stop()
+    assert [r.getMessage() for r in sink.records] == ["keep me"]
+
+
+def test_get_logger_returns_constructed_logger():
+    listener, sink = _make_listener()
+    try:
+        assert listener.get_logger().handlers == [sink]
+    finally:
+        listener.stop()

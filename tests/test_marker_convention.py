@@ -1,4 +1,4 @@
-"""Marker-convention guard: bench-driving tests must be ``slow``-marked.
+"""Static convention guards: test markers and the one-ledger rule.
 
 The driver's tier-1 gate runs ``pytest -m 'not slow'`` inside a 870s
 budget (ROADMAP.md).  Any test that shells out to ``bench.py`` pays a
@@ -7,6 +7,11 @@ seconds — so it must carry ``@pytest.mark.slow`` or it silently eats the
 tier-1 budget.  A static AST scan (collection-speed, no imports) rather
 than a runtime fixture: the convention must hold even for tests that
 would be skipped on this platform.
+
+The same file also pins the telemetry layer's structural invariant: all
+observability counters flow through ``telemetry/registry.py`` — a new
+ad-hoc counter store (``self._counters = {}``-style) anywhere else in the
+package is rejected at collection speed.
 """
 import ast
 import pathlib
@@ -90,4 +95,64 @@ def test_fault_injection_tests_are_slow_or_chaos_marked():
         "fault-injection tests that spawn processes or sleep out timers "
         "must be @pytest.mark.slow or @pytest.mark.chaos: "
         f"{offenders}"
+    )
+
+
+# Names that announce "I am a counter ledger".  Before the telemetry layer
+# (PR 6) each subsystem grew one of these and every snapshot had its own
+# schema; now the process registry (telemetry/registry.py) is the single
+# store and ``fault.counters()`` / ``ServingMetrics.snapshot()`` are views
+# of it.  Pattern-matched on the assigned NAME, not the value, so both
+# ``self._counters = {}`` and ``self._counters = Counter()`` trip it.
+_COUNTER_STORE_NAMES = ("_counters", "counters", "_counter_store")
+_COUNTER_STORE_VALUES = ("dict", "Counter", "defaultdict", "OrderedDict")
+
+
+def _is_counter_store(node: ast.AST) -> bool:
+    """An Assign/AnnAssign binding a counter-ish name to a fresh mapping."""
+    if isinstance(node, ast.AnnAssign):
+        targets, value = [node.target], node.value
+    elif isinstance(node, ast.Assign):
+        targets, value = node.targets, node.value
+    else:
+        return False
+    named = False
+    for t in targets:
+        name = t.attr if isinstance(t, ast.Attribute) else (
+            t.id if isinstance(t, ast.Name) else ""
+        )
+        if name in _COUNTER_STORE_NAMES or name.endswith("_counters"):
+            named = True
+    if not named:
+        return False
+    if isinstance(value, ast.Dict) and not value.keys:
+        return True  # = {}
+    if isinstance(value, ast.Call):
+        fn = value.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        return fn_name in _COUNTER_STORE_VALUES
+    return False
+
+
+def test_no_ad_hoc_counter_stores_outside_telemetry():
+    """Every package module except ``telemetry/`` must route counters
+    through the registry: assigning ``self._counters = {}`` (or a
+    ``Counter()``/``defaultdict()``) reintroduces a private ledger the
+    goodput snapshot and ``summary()`` cannot see."""
+    pkg = pathlib.Path(__file__).parent.parent / "pytorch_distributed_training_tpu"
+    offenders = []
+    for path in sorted(pkg.rglob("*.py")):
+        rel = path.relative_to(pkg)
+        if rel.parts[0] == "telemetry":
+            continue  # the one place counter stores are allowed to live
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if _is_counter_store(node):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "ad-hoc counter store(s) outside telemetry/ — use "
+        "telemetry.registry (get_registry().counter(name) or a private "
+        f"MetricsRegistry for instance-local counts): {offenders}"
     )
