@@ -746,9 +746,16 @@ def bench_serve():
     percentiles instead of being hidden by client backpressure).  One JSON
     line: p50/p99 request latency, items/sec, compile count.
 
-      BENCH_SERVE_CONFIG    serve-*.yml (default config/serve-lm.yml)
-      BENCH_SERVE_REQUESTS  total requests (default 64)
-      BENCH_SERVE_RATE      arrivals/sec; 0 = fire all at once (default 50)
+      BENCH_SERVE_CONFIG      serve-*.yml (default config/serve-lm.yml)
+      BENCH_SERVE_REQUESTS    total requests (default 64)
+      BENCH_SERVE_RATE        arrivals/sec; 0 = fire all at once (default 50)
+      BENCH_SERVE_GENLEN_MIX  LM only: comma list of per-request max-new-token
+                              caps cycled across the stream (e.g. "1,8") — a
+                              mixed-length workload stresses the whole-batch
+                              pathology (one long row stalls its whole batch)
+                              that the continuous scheduler removes
+      BENCH_SERVE_SCHEDULER   1/0: force serving.scheduler.enabled on/off,
+                              overriding the config — the A/B switch
     """
     import numpy as np
 
@@ -758,7 +765,16 @@ def bench_serve():
     cfg_path = os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "64"))
     rate = float(os.environ.get("BENCH_SERVE_RATE", "50"))
+    genlen_mix = [
+        int(g) for g in os.environ.get("BENCH_SERVE_GENLEN_MIX", "").split(",")
+        if g.strip()
+    ]
     cfg = get_serve_cfg(cfg_path)
+    sched_env = os.environ.get("BENCH_SERVE_SCHEDULER")
+    if sched_env is not None:
+        sched_cfg = dict(cfg["serving"].get("scheduler") or {})
+        sched_cfg["enabled"] = sched_env not in ("0", "false", "")
+        cfg["serving"]["scheduler"] = sched_cfg
     rng = np.random.default_rng(0)
 
     with InferenceEngine.from_config(cfg) as engine:
@@ -771,10 +787,19 @@ def bench_serve():
             size = engine.image_size
             return rng.integers(0, 256, (size, size, 3)).astype(np.uint8)
 
+        def cap_for(i):
+            if not (genlen_mix and engine.is_lm):
+                return None
+            return min(genlen_mix[i % len(genlen_mix)], engine.max_new_tokens)
+
         # warm the compile(s) outside the measured stream so the percentiles
         # reflect steady-state serving, not first-request XLA compilation
         engine.submit(payload()).result(timeout=600)
         engine.metrics = type(engine.metrics)()
+        if engine.scheduler is not None:
+            # the scheduler records into the engine's ledger — repoint it
+            # at the fresh one or the warmup request pollutes the stream
+            engine.scheduler.metrics = engine.metrics
 
         t0 = time.perf_counter()
         futures = []
@@ -783,7 +808,9 @@ def bench_serve():
                 lag = t0 + i / rate - time.perf_counter()
                 if lag > 0:
                     time.sleep(lag)
-            futures.append(engine.submit(payload()))
+            futures.append(
+                engine.submit(payload(), max_new_tokens=cap_for(i))
+            )
         for fut in futures:
             fut.result(timeout=600)
         snap = engine.metrics.snapshot()
@@ -803,6 +830,24 @@ def bench_serve():
                 "batch_size_mean": round(snap.get("batch_size_mean", 0.0), 2),
                 "max_queue_depth": snap.get("max_queue_depth", 0),
                 "compile_count": compile_count,
+                "scheduler": engine.scheduler is not None,
+                **(
+                    {"genlen_mix": genlen_mix}
+                    if genlen_mix and engine.is_lm else {}
+                ),
+                # continuous-scheduler shape (absent on the batcher path)
+                **(
+                    {
+                        "slot_occupancy_mean": round(
+                            snap["slot_occupancy_mean"], 3
+                        )
+                    }
+                    if "slot_occupancy_mean" in snap else {}
+                ),
+                **(
+                    {"prefix_hit_rate": round(snap["prefix_hit_rate"], 3)}
+                    if "prefix_hit_rate" in snap else {}
+                ),
                 # LM-only phase split (round 6): prefill is the batched
                 # prompt forward (prompt tokens/s), decode the incremental
                 # KV-cache loop (generated tokens/s) — absent for images

@@ -76,13 +76,19 @@ class DecoderBlock(nn.Module):
     # serve directly.
     decode: bool = False
     cache_len: int = 0
+    # Paged KV cache (serving/kv_pool.py): blocks of ``kv_block_size`` token
+    # rows from a shared ``kv_num_blocks`` pool, addressed per call through
+    # ``block_tables`` — see MultiHeadAttention.paged.
+    paged: bool = False
+    kv_block_size: int = 0
+    kv_num_blocks: int = 0
     # Fuse the residual-add+ln2 and fc1-bias+gelu elementwise tails into
     # single Pallas kernels (ops/fused_elementwise.py).  Same parameter
     # tree either way (checkpoint-compatible); off by default.
     fused_tails: bool = False
 
     @nn.compact
-    def __call__(self, x, decode_pos=None):
+    def __call__(self, x, decode_pos=None, block_tables=None):
         dim = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         attn_out = MultiHeadAttention(
@@ -94,8 +100,11 @@ class DecoderBlock(nn.Module):
             flash_mesh=self.flash_mesh,
             decode=self.decode,
             cache_len=self.cache_len,
+            paged=self.paged,
+            kv_block_size=self.kv_block_size,
+            kv_num_blocks=self.kv_num_blocks,
             name="attn",
-        )(y, decode_pos)
+        )(y, decode_pos, block_tables)
         if self.fused_tails and self.moe_experts == 0:
             from ..ops.fused_elementwise import FusedResidualLayerNorm
 
@@ -174,9 +183,18 @@ class TransformerLM(nn.Module):
     # returns its logits.  Mutually exclusive with seq_axis/MoE (serving is
     # single-shard dense; enforced below).
     decode: bool = False
+    # Paged KV cache (serving/kv_pool.py): with ``decode=True, paged=True``
+    # the per-layer cache is a shared pool of ``kv_num_blocks`` blocks of
+    # ``kv_block_size`` token rows; ``decode_pos`` becomes [B, S] per-token
+    # global positions (-1 = padding) and ``block_tables`` [B, T] maps each
+    # row's logical blocks to physical pool blocks, so one program shape
+    # covers cold prefill, prefix-hit chunked prefill, and S=1 decode.
+    paged: bool = False
+    kv_block_size: int = 0
+    kv_num_blocks: int = 0
 
     @nn.compact
-    def __call__(self, tokens, decode_pos=None):
+    def __call__(self, tokens, decode_pos=None, block_tables=None):
         if self.moe_experts > 0 and self.moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
         if self.decode and self.seq_axis is not None:
@@ -185,6 +203,10 @@ class TransformerLM(nn.Module):
             raise ValueError("decode mode does not support MoE blocks yet")
         if decode_pos is not None and not self.decode:
             raise ValueError("decode_pos given but model was not cloned with decode=True")
+        if self.paged and not self.decode:
+            raise ValueError("paged KV mode requires decode=True")
+        if self.paged and decode_pos is not None and block_tables is None:
+            raise ValueError("paged KV mode needs block_tables alongside decode_pos")
         b, s = tokens.shape
         emb = self.param(
             "tok_embedding",
@@ -199,7 +221,13 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         x = jnp.take(emb, tokens, axis=0).astype(self.dtype)
-        if decode_pos is not None:
+        if decode_pos is not None and self.paged:
+            # paged decode_pos is [B, S] per-token global positions; -1
+            # padding clamps to row 0 (its output is discarded by the host)
+            pe = jnp.take(
+                pos, jnp.clip(decode_pos, 0, self.max_len - 1), axis=0
+            )  # [B, S, E]
+        elif decode_pos is not None:
             # one new token per row at its own position: gather that row's
             # position embedding instead of slicing a shared prefix
             pe = jnp.take(pos, decode_pos, axis=0)[:, None]  # [B, 1, E]
@@ -252,8 +280,11 @@ class TransformerLM(nn.Module):
                 ),
                 decode=self.decode,
                 cache_len=self.max_len if self.decode else 0,
+                paged=self.paged,
+                kv_block_size=self.kv_block_size,
+                kv_num_blocks=self.kv_num_blocks,
                 fused_tails=self.fused_tails,
                 name=f"block{i}",
-            )(x, decode_pos)
+            )(x, decode_pos, block_tables)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

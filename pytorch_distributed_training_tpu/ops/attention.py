@@ -207,9 +207,22 @@ class MultiHeadAttention(nn.Module):
     # batches of different prompt lengths in one jit program.
     decode: bool = False
     cache_len: int = 0
+    # Paged KV cache (serving/kv_pool.py + serving/scheduler.py): instead of
+    # a per-row contiguous [B, cache_len] cache, k/v live in a SHARED pool of
+    # ``kv_num_blocks`` blocks of ``kv_block_size`` token rows, and each row
+    # of a call carries a block table mapping its logical positions to
+    # physical pool blocks.  ``decode_pos`` becomes [B, S] per-TOKEN global
+    # positions (-1 = padding: its scatter is dropped and its output is
+    # garbage the host ignores), so ONE program shape handles cold prefill,
+    # chunked prefix-hit prefill, and single-token decode (S=1).  Blocks
+    # reused from a prefix cache are read-only here by construction: the
+    # scatter only covers the caller's own (suffix) positions.
+    paged: bool = False
+    kv_block_size: int = 0
+    kv_num_blocks: int = 0
 
     @nn.compact
-    def __call__(self, x, decode_pos=None):
+    def __call__(self, x, decode_pos=None, block_tables=None):
         b, s, dim = x.shape
         if dim % self.num_heads != 0:
             raise ValueError(f"embed dim {dim} not divisible by {self.num_heads} heads")
@@ -222,7 +235,9 @@ class MultiHeadAttention(nn.Module):
         # collectives (see parallel.tensor)
         qkv = qkv.reshape(b, s, self.num_heads, 3, head_dim)
         q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-        if self.decode:
+        if self.decode and self.paged:
+            out = self._paged_attention(q, k, v, decode_pos, block_tables)
+        elif self.decode:
             out = self._decode_attention(q, k, v, decode_pos)
         elif self.seq_axis is None:
             out = dot_product_attention(
@@ -281,6 +296,78 @@ class MultiHeadAttention(nn.Module):
             jnp.arange(cache_len, dtype=jnp.int32)[None, :] <= decode_pos[:, None]
         )  # [B, L]
         logits = jnp.where(live[:, None, None, :], logits, float("-inf"))
+        p = jnp.asarray(nn.softmax(logits, axis=-1))
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    def _paged_attention(self, q, k, v, positions, block_tables):
+        """Block-table gather attention against the shared paged KV pool.
+
+        ``positions`` [B, S] int32: each token's GLOBAL sequence position in
+        its request (-1 = padding column).  ``block_tables`` [B, T] int32:
+        physical pool block holding logical block ``t`` (positions
+        ``[t*bs, (t+1)*bs)``) of row ``b``.  The pool lives flattened as
+        ``[num_blocks * block_size, H, hd]`` in the "cache" collection —
+        scatter this call's k/v at their physical rows (padding scatters are
+        dropped via an out-of-bounds index), then gather each row's FULL
+        logical sequence back through its block table and mask keys to
+        ``key_pos <= q_pos``.  Because suffix k/v are scattered before the
+        gather, one code path serves cold prefill (positions 0..len-1),
+        chunked prefix-hit prefill (positions cached_len..len-1 reading the
+        shared prefix blocks), and single-token decode (S=1).  Gathered
+        garbage beyond a row's written length is masked to -inf, so recycled
+        block contents never leak into the softmax.
+        """
+        if self.seq_axis is not None:
+            raise ValueError("paged decode is single-shard (seq_axis must be None)")
+        if not self.causal:
+            raise ValueError("paged decode requires causal attention")
+        bs, nb = self.kv_block_size, self.kv_num_blocks
+        if bs <= 0 or nb <= 0:
+            raise ValueError(
+                f"paged mode needs kv_block_size/kv_num_blocks > 0, "
+                f"got {bs}/{nb}"
+            )
+        if positions is None or block_tables is None:
+            raise ValueError("paged mode needs positions and block_tables")
+        b, s, num_heads, head_dim = q.shape
+        pool_rows = nb * bs
+        k_pool = self.variable(
+            "cache", "k_pool", jnp.zeros, (pool_rows, num_heads, head_dim),
+            self.dtype,
+        )
+        v_pool = self.variable(
+            "cache", "v_pool", jnp.zeros, (pool_rows, num_heads, head_dim),
+            self.dtype,
+        )
+        valid = positions >= 0  # [B, S]
+        safe_pos = jnp.maximum(positions, 0)
+        blk = jnp.take_along_axis(block_tables, safe_pos // bs, axis=1)  # [B, S]
+        phys = jnp.where(valid, blk * bs + safe_pos % bs, pool_rows)  # OOB=drop
+        kp = k_pool.value.at[phys.reshape(-1)].set(
+            k.astype(self.dtype).reshape(b * s, num_heads, head_dim), mode="drop"
+        )
+        vp = v_pool.value.at[phys.reshape(-1)].set(
+            v.astype(self.dtype).reshape(b * s, num_heads, head_dim), mode="drop"
+        )
+        k_pool.value, v_pool.value = kp, vp
+        t_blocks = block_tables.shape[1]
+        length = t_blocks * bs
+        rows = (
+            (block_tables * bs)[:, :, None]
+            + jnp.arange(bs, dtype=jnp.int32)[None, None, :]
+        ).reshape(b, length)  # [B, L] physical rows in logical-position order
+        ck = kp[rows]  # [B, L, H, hd]
+        cv = vp[rows]
+        scale = 1.0 / math.sqrt(head_dim)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.astype(jnp.float32)
+        ) * scale
+        live = (
+            jnp.arange(length, dtype=jnp.int32)[None, None, :]
+            <= safe_pos[:, :, None]
+        )  # [B, S, L]; padding queries keep key 0 live so softmax stays finite
+        logits = jnp.where(live[:, None], logits, float("-inf"))
         p = jnp.asarray(nn.softmax(logits, axis=-1))
         out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
         return out.astype(q.dtype)

@@ -10,18 +10,28 @@ lever — saturate the accelerator by batching — to inference:
   - :mod:`.decode`   — autoregressive generation over the KV-cache decode
     mode of :class:`..models.transformer_lm.TransformerLM`.
   - :mod:`.metrics`  — p50/p99 latency, queue depth, throughput.
+  - :mod:`.scheduler` — :class:`ContinuousScheduler`: iteration-level
+    (continuous) batching — slot array + per-step retire-and-refill.
+  - :mod:`.kv_pool`  — :class:`PagedKVPool`: block allocator, admission
+    control, and prefix cache behind the paged attention mode.
 
 ``python -m pytorch_distributed_training_tpu.serving --config
 config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
 """
 from .batcher import DynamicBatcher
-from .decode import build_generate_fn
+from .decode import build_generate_fn, build_paged_fns
 from .engine import InferenceEngine
+from .kv_pool import BlockAllocator, PagedKVPool
 from .metrics import ServingMetrics
+from .scheduler import ContinuousScheduler
 
 __all__ = [
+    "BlockAllocator",
+    "ContinuousScheduler",
     "DynamicBatcher",
     "InferenceEngine",
+    "PagedKVPool",
     "ServingMetrics",
     "build_generate_fn",
+    "build_paged_fns",
 ]

@@ -25,12 +25,19 @@ Graceful degradation under overload (both off by default):
 
 Both are counted (``timeouts``/``sheds``) and surfaced through optional
 callbacks so ``ServingMetrics`` can aggregate them.
+
+The backlog is a ``deque`` under a ``Condition`` rather than a
+``queue.Queue``: the backlog-depth check must count LIVE requests only,
+which means ``submit`` has to sweep already-expired entries out of the
+queue before comparing against ``max_backlog`` — an opaque ``Queue``
+cannot be swept, so under sustained overload it shed live requests to
+protect doomed ones (the PR 7 fix).
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
@@ -91,9 +98,9 @@ class DynamicBatcher:
         self.sheds = 0
         self._on_timeout = on_timeout
         self._on_shed = on_shed
-        self._queue: "queue.Queue[Optional[Request]]" = queue.Queue()
+        self._queue: "deque[Request]" = deque()
         self._closed = False
-        self._lock = threading.Lock()
+        self._cond = threading.Condition()
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True
         )
@@ -111,16 +118,20 @@ class DynamicBatcher:
         dl = deadline_ms if deadline_ms is not None else self.deadline_ms
         if dl is not None and dl <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {dl}")
-        with self._lock:
+        with self._cond:
             # under the same lock close() takes: a submit that wins the
-            # race lands before the sentinel and is drained; one that
-            # loses raises — a Future can never be enqueued behind a dead
-            # loop to hang forever
+            # race lands before close flips the flag and is drained; one
+            # that loses raises — a Future can never be enqueued behind a
+            # dead loop to hang forever
             if self._closed:
                 raise RuntimeError("batcher is closed")
+            # expired entries are dead weight, not backlog: resolve and
+            # drop them FIRST so the depth check below counts only live
+            # requests (otherwise doomed requests shed live ones)
+            self._sweep_expired_locked()
             if (
                 self.max_backlog is not None
-                and self._queue.qsize() >= self.max_backlog
+                and len(self._queue) >= self.max_backlog
             ):
                 self.sheds += 1
                 if self._on_shed is not None:
@@ -133,20 +144,22 @@ class DynamicBatcher:
                 payload, meta,
                 deadline=(time.monotonic() + dl / 1000.0) if dl else None,
             )
-            self._queue.put(req)
+            self._queue.append(req)
+            self._cond.notify_all()
         return req.future
 
     def depth(self) -> int:
         """Requests currently waiting (approximate, by nature)."""
-        return self._queue.qsize()
+        with self._cond:
+            return len(self._queue)
 
     def close(self) -> None:
         """Drain remaining requests, then stop the flush thread."""
-        with self._lock:
+        with self._cond:
             if self._closed:
                 return
             self._closed = True
-            self._queue.put(None)  # sentinel wakes a blocked get
+            self._cond.notify_all()  # wake a blocked collect
         self._thread.join()
 
     def __enter__(self):
@@ -174,48 +187,51 @@ class DynamicBatcher:
             )
         return True
 
+    def _sweep_expired_locked(self) -> None:
+        """Resolve + remove every over-deadline request (cond held)."""
+        now = time.monotonic()
+        if any(r.deadline is not None and now >= r.deadline for r in self._queue):
+            self._queue = deque(r for r in self._queue if not self._expired(r))
+
     def _collect(self) -> Tuple[List[Request], bool]:
         """Block for the first request, then gather until a flush trigger.
 
-        Returns ``(batch, stop)``; stop means the sentinel was seen (any
-        gathered batch is still flushed first — close() drains).  Requests
-        past their deadline are expired here instead of batched.
+        Returns ``(batch, stop)``; stop means close() was seen and the
+        queue is drained (any gathered batch is still flushed first —
+        close() drains).  Requests past their deadline are expired here
+        instead of batched.
         """
-        while True:
-            first = self._queue.get()
-            if first is None:
-                return [], True
-            if not self._expired(first):
-                break
-        batch = [first]
-        # a backlog that built while the previous batch ran must flush at
-        # full width immediately — grab whatever already waits before ever
-        # consulting the delay deadline (which the oldest request may well
-        # have passed by now; timing out to a singleton batch here would
-        # serialize the whole backlog one request at a time)
-        while len(batch) < self.max_batch_size:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if req is None:
-                return batch, True
-            if not self._expired(req):
-                batch.append(req)
-        deadline = first.enqueued_at + self.max_delay
-        while len(batch) < self.max_batch_size:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                break
-            try:
-                req = self._queue.get(timeout=remaining)
-            except queue.Empty:
-                break
-            if req is None:
-                return batch, True
-            if not self._expired(req):
-                batch.append(req)
-        return batch, False
+        with self._cond:
+            while True:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if not self._queue:
+                    return [], True  # closed and fully drained
+                first = self._queue.popleft()
+                if not self._expired(first):
+                    break
+            batch = [first]
+            # a backlog that built while the previous batch ran must flush
+            # at full width immediately — grab whatever already waits
+            # before ever consulting the delay deadline (which the oldest
+            # request may well have passed by now; timing out to a
+            # singleton batch here would serialize the whole backlog one
+            # request at a time)
+            while len(batch) < self.max_batch_size and self._queue:
+                req = self._queue.popleft()
+                if not self._expired(req):
+                    batch.append(req)
+            deadline = first.enqueued_at + self.max_delay
+            while len(batch) < self.max_batch_size and not self._closed:
+                if self._queue:
+                    req = self._queue.popleft()
+                    if not self._expired(req):
+                        batch.append(req)
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=remaining):
+                    break
+            return batch, False
 
     def _flush(self, batch: List[Request]) -> None:
         try:
@@ -241,16 +257,12 @@ class DynamicBatcher:
                 req.future.set_result(res)
 
     def _loop(self) -> None:
+        # drain-on-close falls out of _collect: once closed it keeps
+        # returning batches (without the timed fill) until the queue is
+        # empty, and only then reports stop
         while True:
             batch, stop = self._collect()
             if batch:
                 self._flush(batch)
             if stop:
-                # drain anything enqueued before close() won the race
-                while True:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        return
-                    if req is not None and not self._expired(req):
-                        self._flush([req])
+                return

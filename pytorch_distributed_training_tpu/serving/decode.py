@@ -18,6 +18,19 @@ decode is latency-bound (max_new_tokens tiny steps); one fused program
 hides which side a serving regression lives on.  ``build_generate_fn``
 returns a callable object: ``__call__`` chains the phases (the original
 contract), ``.prefill`` / ``.decode`` expose them for phase-timed serving.
+
+Sampling is keyed PER ROW, PER TOKEN INDEX: row ``r`` of a batch draws
+token ``i`` with ``fold_in(fold_in(rng, r), i)`` (token 0 is the one the
+prefill program samples).  A row's token stream therefore depends only on
+its own key and its own logits — never on batch composition — which is
+what lets the continuous scheduler (serving/scheduler.py) re-batch rows
+between decode steps and still reproduce the whole-batch path token for
+token (the sampled-mode half of the decode-parity oracle).
+
+``build_paged_fns`` is the paged twin over the block-table cache mode of
+``ops/attention.py``: one prefill program per (batch, seq) bucket and ONE
+single-token step program shared by every decode iteration, both over a
+pool pytree threaded through the calls instead of a per-batch cache.
 """
 from __future__ import annotations
 
@@ -26,7 +39,35 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["build_generate_fn"]
+__all__ = ["build_generate_fn", "build_paged_fns"]
+
+
+def _make_sampler(temperature: float):
+    """``sample(logits [B, V], keys [B]) -> tok [B]``: greedy argmax at
+    temperature 0 (keys ignored), else a per-row categorical draw — vmapped
+    so row r's draw consumes ONLY ``keys[r]`` and ``logits[r]`` and is
+    bitwise independent of every other row."""
+    if temperature == 0.0:
+        return lambda logits, keys: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def sample(logits, keys):
+        draw = lambda k, l: jax.random.categorical(k, l / temperature)
+        return jax.vmap(draw)(keys, logits).astype(jnp.int32)
+
+    return sample
+
+
+def _row_keys(rng, b: int):
+    """One independent PRNG key per batch row: ``fold_in(rng, row)``."""
+    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        rng, jnp.arange(b, dtype=jnp.int32)
+    )
+
+
+def _token_keys(row_keys, index):
+    """Key for generated-token ``index`` (scalar or [B]) of each row."""
+    axis = 0 if jnp.ndim(index) else None
+    return jax.vmap(jax.random.fold_in, in_axes=(0, axis))(row_keys, index)
 
 
 class _GenerateFn:
@@ -80,11 +121,7 @@ def build_generate_fn(
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     decode_model = model.clone(decode=True)
     max_len = model.max_len
-
-    def sample(logits, rng):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(rng, logits / temperature).astype(jnp.int32)
+    sample = _make_sampler(temperature)
 
     def hit_eos(tok):
         if eos_id is None:
@@ -111,23 +148,23 @@ def build_generate_fn(
         last = jnp.take_along_axis(
             prefill_logits, (prompt_len - 1)[:, None, None], axis=1
         )[:, 0]
-        rng, sub = jax.random.split(rng)
-        tok = sample(last, sub)
+        row_keys = _row_keys(rng, b)
+        tok = sample(last, _token_keys(row_keys, 0))
         done = hit_eos(tok)
         out = jnp.zeros((b, max_new_tokens), jnp.int32).at[:, 0].set(tok)
         gen_len = jnp.ones((b,), jnp.int32)
-        return cache, tok, out, done, gen_len, rng
+        return cache, tok, out, done, gen_len, row_keys
 
     @jax.jit
     def decode(params, prompt_len, carry):
-        cache0, tok0, out0, done0, gen_len0, rng0 = carry
+        cache0, tok0, out0, done0, gen_len0, row_keys0 = carry
 
         def cond(c):
             i, _, _, _, done, _, _ = c
             return (i < max_new_tokens) & ~done.all()
 
         def body(c):
-            i, cache, prev, out, done, gen_len, rng = c
+            i, cache, prev, out, done, gen_len, row_keys = c
             # prev = generated token i-1, which sits at sequence position
             # prompt_len + i - 1; feeding it yields the logits for token i
             pos = prompt_len + i - 1
@@ -138,15 +175,114 @@ def build_generate_fn(
                 mutable=["cache"],
             )
             cache = variables["cache"]
-            rng, sub = jax.random.split(rng)
-            tok = sample(logits[:, 0], sub)
+            tok = sample(logits[:, 0], _token_keys(row_keys, i))
             out = out.at[:, i].set(jnp.where(done, 0, tok))
             gen_len = gen_len + jnp.where(done, 0, 1).astype(jnp.int32)
             done = done | hit_eos(tok) | (pos + 1 >= max_len)
-            return (i + 1, cache, tok, out, done, gen_len, rng)
+            return (i + 1, cache, tok, out, done, gen_len, row_keys)
 
-        full = (jnp.int32(1), cache0, tok0, out0, done0, gen_len0, rng0)
+        full = (jnp.int32(1), cache0, tok0, out0, done0, gen_len0, row_keys0)
         _, _, _, out, _, gen_len, _ = jax.lax.while_loop(cond, body, full)
         return out, gen_len
 
     return _GenerateFn(prefill, decode)
+
+
+class _PagedFns:
+    """Jit pair + pool factory for the paged (block-table) cache mode.
+
+    ``prefill(params, pool, tokens, positions, block_tables, last_col,
+    row_keys) -> (tok0, pool)`` — scatter the suffix K/V into the pool and
+    sample each row's first token from the logits at ``last_col``.
+    ``decode_step(params, pool, prev_tok, pos, block_tables, row_keys,
+    gen_index) -> (tok, pool)`` — ONE single-token step for every slot;
+    the scheduler's host loop supplies fresh inputs per iteration, so this
+    one program serves any mix of in-flight requests.
+    ``init_pool(params)`` — the zero pool pytree (``jax.eval_shape`` over
+    the apply: correct flax cache paths, no throwaway compile).
+    """
+
+    def __init__(self, prefill, decode_step, init_pool):
+        self.prefill = prefill
+        self.decode_step = decode_step
+        self.init_pool = init_pool
+
+    def _cache_size(self) -> int:
+        """Distinct XLA programs compiled across both phases — the
+        scheduler's compile count is bounded by the bucket grid for
+        prefill plus ONE decode program, independent of traffic."""
+        return self.prefill._cache_size() + self.decode_step._cache_size()
+
+
+def build_paged_fns(
+    model,
+    block_size: int,
+    num_blocks: int,
+    temperature: float = 0.0,
+):
+    """Compile the paged prefill/decode pair over a shared block pool.
+
+    Shapes are the scheduler's contract: ``tokens``/``positions`` are
+    [B, S] (positions are GLOBAL sequence positions per token, -1 =
+    padding — one program handles cold prefill, prefix-hit suffix prefill,
+    and S=1 decode alike), ``block_tables`` is [B, T] physical block ids
+    covering each row's whole reserved footprint, ``last_col`` [B] is the
+    column of each row's final real token, ``row_keys`` [B] the per-row
+    PRNG keys, ``gen_index`` [B] each row's generated-token index (rows
+    sit at DIFFERENT indices under continuous batching).  Every array is
+    fixed-width; inactive rows ride along with position -1 (their scatter
+    drops, their sampled token is ignored host-side).
+    """
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    if num_blocks < 1:
+        raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    paged_model = model.clone(
+        decode=True, paged=True,
+        kv_block_size=int(block_size), kv_num_blocks=int(num_blocks),
+    )
+    # no eos_id here: EOS detection is the HOST's job in paged mode — the
+    # scheduler reads every token anyway (to stream it and retire slots),
+    # so the programs stay pure token-samplers and the stop conditions
+    # (eos / per-request max_new) live in one place
+    sample = _make_sampler(temperature)
+
+    @jax.jit
+    def prefill(params, pool, tokens, positions, block_tables, last_col, row_keys):
+        logits, variables = paged_model.apply(
+            {"params": params, "cache": pool},
+            tokens, positions, block_tables, mutable=["cache"],
+        )
+        last = jnp.take_along_axis(logits, last_col[:, None, None], axis=1)[:, 0]
+        tok = sample(last, _token_keys(row_keys, 0))
+        return tok, variables["cache"]
+
+    @jax.jit
+    def decode_step(params, pool, prev_tok, pos, block_tables, row_keys, gen_index):
+        logits, variables = paged_model.apply(
+            {"params": params, "cache": pool},
+            prev_tok[:, None], pos[:, None], block_tables, mutable=["cache"],
+        )
+        tok = sample(logits[:, 0], _token_keys(row_keys, gen_index))
+        return tok, variables["cache"]
+
+    def init_pool(params):
+        # any concrete shapes work — the pool's shape depends only on the
+        # model config, and eval_shape never touches device memory
+        shapes = jax.eval_shape(
+            lambda p: paged_model.apply(
+                {"params": p},
+                jnp.zeros((1, 1), jnp.int32),
+                jnp.zeros((1, 1), jnp.int32),
+                jnp.zeros((1, 1), jnp.int32),
+                mutable=["cache"],
+            )[1]["cache"],
+            params,
+        )
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
+
+    return _PagedFns(prefill, decode_step, init_pool)

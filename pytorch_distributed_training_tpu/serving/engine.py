@@ -35,6 +35,7 @@ from ..parallel.mesh import (
 from .batcher import DynamicBatcher, Request
 from .decode import build_generate_fn
 from .metrics import ServingMetrics
+from .scheduler import ContinuousScheduler
 
 __all__ = ["InferenceEngine"]
 
@@ -77,6 +78,7 @@ class InferenceEngine:
         image_size: int = 0,
         input_norm=None,
         seed: int = 0,
+        scheduler: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
     ):
         self.model = model
@@ -123,14 +125,46 @@ class InferenceEngine:
         )
         self._rng = jax.random.PRNGKey(seed)
         self._batch_counter = 0
-        self.batcher = DynamicBatcher(
-            self._run_batch, max_batch_size, max_delay_ms,
-            deadline_ms=deadline_ms, max_backlog=max_backlog,
-            # degradation events land in the same metrics ledger as
-            # latency/throughput, so one snapshot tells the whole story
-            on_timeout=lambda: self.metrics.incr("timeouts"),
-            on_shed=lambda: self.metrics.incr("sheds"),
-        )
+        # continuous batching (serving.scheduler.enabled): the LM decode
+        # loop moves to the iteration-level scheduler over the paged KV
+        # pool; the DynamicBatcher path stays the default (and the only
+        # path for classification and multi-host serving)
+        sched_cfg = dict(scheduler or {})
+        use_sched = is_lm and bool(sched_cfg.pop("enabled", False))
+        self.scheduler: Optional[ContinuousScheduler] = None
+        self.batcher: Optional[DynamicBatcher] = None
+        if use_sched:
+            self.scheduler = ContinuousScheduler(
+                model, self.params,
+                slots=int(sched_cfg.pop("slots", 8)),
+                block_size=int(sched_cfg.pop("block_size", 16)),
+                num_blocks=int(sched_cfg.pop("num_blocks", 64)),
+                prefix_cache=bool(sched_cfg.pop("prefix_cache", True)),
+                batch_buckets=self.batch_buckets,
+                seq_buckets=self.seq_buckets,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                eos_id=eos_id,
+                deadline_ms=deadline_ms,
+                max_backlog=max_backlog,
+                metrics=self.metrics,
+                seed=seed,
+                pool_sharding=rep,
+                logger=self.logger,
+            )
+            if sched_cfg:
+                raise ValueError(
+                    f"unknown serving.scheduler keys: {sorted(sched_cfg)}"
+                )
+        else:
+            self.batcher = DynamicBatcher(
+                self._run_batch, max_batch_size, max_delay_ms,
+                deadline_ms=deadline_ms, max_backlog=max_backlog,
+                # degradation events land in the same metrics ledger as
+                # latency/throughput, so one snapshot tells the whole story
+                on_timeout=lambda: self.metrics.incr("timeouts"),
+                on_shed=lambda: self.metrics.incr("sheds"),
+            )
 
     # ------------------------------------------------------------------ #
 
@@ -203,17 +237,30 @@ class InferenceEngine:
             image_size=image_size,
             input_norm=input_norm,
             seed=int(serve.get("seed", 0)),
+            scheduler=serve.get("scheduler"),
             logger=logger,
         )
 
     # ------------------------------------------------------------------ #
 
-    def submit(self, payload, deadline_ms: Optional[float] = None):
+    def submit(
+        self,
+        payload,
+        deadline_ms: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+        on_token=None,
+        rng=None,
+    ):
         """Validate + enqueue one request; returns its result future.
 
         ``deadline_ms`` overrides the engine's default per-request
         deadline (``serving.deadline_ms``); past it an unflushed request
-        resolves with ``TimeoutError``.
+        resolves with ``TimeoutError``.  LM-only extras: ``max_new_tokens``
+        caps this request below ``serving.max_new_tokens`` (on the
+        batcher path the result is truncated host-side — the batch still
+        pays the full decode; the scheduler path retires the slot the
+        moment the cap is hit), ``on_token``/``rng`` stream tokens /
+        override the sampling key and need the continuous scheduler.
         """
         if self.is_lm:
             prompt = np.asarray(payload, np.int32)
@@ -227,7 +274,30 @@ class InferenceEngine:
                     f"prompt length {prompt.size} exceeds largest seq "
                     f"bucket {self.seq_buckets[-1]}"
                 )
-            return self.batcher.submit(prompt, deadline_ms=deadline_ms)
+            if max_new_tokens is not None and not (
+                1 <= int(max_new_tokens) <= self.max_new_tokens
+            ):
+                raise ValueError(
+                    f"max_new_tokens must be in [1, {self.max_new_tokens}], "
+                    f"got {max_new_tokens}"
+                )
+            if self.scheduler is not None:
+                return self.scheduler.submit(
+                    prompt, deadline_ms=deadline_ms,
+                    max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
+                )
+            if on_token is not None or rng is not None:
+                raise ValueError(
+                    "on_token / per-request rng require "
+                    "serving.scheduler.enabled (the batcher path samples "
+                    "whole batches and resolves futures only at the end)"
+                )
+            return self.batcher.submit(
+                prompt, deadline_ms=deadline_ms,
+                max_new=(int(max_new_tokens) if max_new_tokens else None),
+            )
+        if max_new_tokens is not None or on_token is not None or rng is not None:
+            raise ValueError("max_new_tokens/on_token/rng are LM-only")
         img = np.asarray(payload)
         want = (self.image_size, self.image_size, 3)
         if img.shape != want:
@@ -235,15 +305,22 @@ class InferenceEngine:
         return self.batcher.submit(img, deadline_ms=deadline_ms)
 
     def depth(self) -> int:
+        if self.scheduler is not None:
+            return self.scheduler.depth()
         return self.batcher.depth()
 
     def compile_count(self) -> int:
         """Number of distinct XLA programs compiled so far (<= bucket grid)."""
+        if self.scheduler is not None:
+            return self.scheduler.compile_count()
         fn = self._generate if self.is_lm else self._classify
         return fn._cache_size()
 
     def close(self) -> None:
-        self.batcher.close()
+        if self.scheduler is not None:
+            self.scheduler.close()
+        else:
+            self.batcher.close()
 
     def __enter__(self):
         return self
@@ -308,10 +385,16 @@ class InferenceEngine:
         out = np.asarray(out)  # host materialization = decode sync
         gen_len = np.asarray(gen_len)
         t2 = time.perf_counter()
-        results = [
-            {"tokens": out[i, : gen_len[i]], "gen_len": int(gen_len[i])}
-            for i in range(len(requests))
-        ]
+        results = []
+        for i, req in enumerate(requests):
+            g = int(gen_len[i])
+            # per-request cap on the batch path: TRUNCATE host-side — the
+            # whole batch already paid the full decode loop, which is
+            # precisely the pathology the continuous scheduler removes
+            cap = req.meta.get("max_new")
+            if cap:
+                g = min(g, int(cap))
+            results.append({"tokens": out[i, :g], "gen_len": g})
         phase = dict(
             prompt_tokens=int(sum(lens)), prefill_s=t1 - t0, decode_s=t2 - t1
         )

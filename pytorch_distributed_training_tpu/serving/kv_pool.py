@@ -1,0 +1,223 @@
+"""Paged KV-cache pool: block allocator, prefix cache, admission control.
+
+Host-side bookkeeping for the paged attention mode (ops/attention.py
+``MultiHeadAttention.paged``): device memory is ONE preallocated pool of
+``num_blocks`` blocks of ``block_size`` token rows per layer, and each
+in-flight request owns a list of physical block ids covering its prompt
+plus its whole generation budget.  The vLLM construction (PagedAttention,
+Kwon et al. SOSP'23) — cache memory stops being per-batch contiguous
+slabs sized for the worst case and becomes a recyclable heap, which is
+what lets the iteration-level scheduler (serving/scheduler.py) keep
+admitting new requests while long generations run.
+
+Admission control instead of OOM: :meth:`PagedKVPool.admit` reserves a
+request's ENTIRE worst-case footprint (``ceil((prompt + max_new) /
+block_size)`` blocks, minus prefix-cache reuse) up front and returns
+``None`` when the pool cannot cover it — the request waits in the queue;
+the pool can never over-commit and a running request can never be killed
+mid-generation for memory.  (The alternative — allocate-on-demand with
+preempt-and-recompute eviction — buys higher occupancy at the cost of
+wasted work; documented as future work in the ROADMAP.)
+
+Prefix caching: completed prefills register their FULL prompt blocks
+under a chained key of the exact token contents, so a later request whose
+prompt shares a block-aligned prefix reuses those blocks without
+recomputing them (refcounted: shared blocks are read-only by construction
+because the paged attention scatter only covers suffix positions).  At
+least the last prompt token is always recomputed (the first sampled token
+needs its logits), so reuse is capped at ``(prompt_len - 1) // block_size``
+blocks.  Cache entries hold their own reference; when the allocator runs
+dry, least-recently-used entries whose only holder is the cache are
+evicted to the free list.  Evicting a chain-middle entry strands its
+descendants (unreachable by lookup) — they are reclaimed by the same LRU
+sweep when their turn comes.
+
+No locks: all mutation happens on the scheduler's single loop thread.
+Counters (admitted / prefix hits / evictions) are the scheduler's job and
+flow through ``ServingMetrics`` / the telemetry registry, keeping this
+module pure bookkeeping.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+__all__ = ["Admission", "BlockAllocator", "PagedKVPool"]
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` physical block ids."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO recycling: recently-freed blocks are re-issued first, which
+        # keeps the working set of pool rows small
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._allocated: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or ``None`` when the free list cannot cover it
+        (all-or-nothing: a partial grant could deadlock two waiters)."""
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
+        return out
+
+    def free(self, block_ids: Sequence[int]) -> None:
+        for b in block_ids:
+            if b not in self._allocated:
+                raise ValueError(f"double free of block {b}")
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+class Admission:
+    """One admitted request's block reservation.
+
+    ``block_ids`` covers the whole worst-case sequence in logical order;
+    the first ``n_shared`` entries are refcounted prefix-cache blocks
+    (read-only), holding positions ``[0, cached_len)``.
+    """
+
+    __slots__ = ("block_ids", "n_shared", "cached_len")
+
+    def __init__(self, block_ids: List[int], n_shared: int, block_size: int):
+        self.block_ids = block_ids
+        self.n_shared = n_shared
+        self.cached_len = n_shared * block_size
+
+
+class PagedKVPool:
+    """Allocator + refcounts + prefix cache over one block pool."""
+
+    def __init__(
+        self, num_blocks: int, block_size: int, prefix_cache: bool = True
+    ):
+        self._alloc = BlockAllocator(num_blocks, block_size)
+        self.prefix_cache = bool(prefix_cache)
+        self._ref: dict = {}  # block id -> holders (requests + cache)
+        # chained-content key -> block id, in LRU order (see _chain_keys)
+        self._cache: "OrderedDict[tuple, int]" = OrderedDict()
+        self.prefix_evictions = 0
+
+    @property
+    def num_blocks(self) -> int:
+        return self._alloc.num_blocks
+
+    @property
+    def block_size(self) -> int:
+        return self._alloc.block_size
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self._alloc.num_blocks - self._alloc.num_free
+
+    def blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        bs = self.block_size
+        return -(-(prompt_len + max_new) // bs)
+
+    # ------------------------------------------------------------------ #
+
+    def _chain_keys(self, prompt: Sequence[int]):
+        """(key, block_index) for each reusable FULL prompt block: the key
+        chains the exact token contents of every block up to this one, so
+        equal keys imply bitwise-equal cached K/V.  Capped below the last
+        prompt token — its logits must always be recomputed."""
+        bs = self.block_size
+        key: tuple = ()
+        for i in range((len(prompt) - 1) // bs):
+            key = (key, tuple(int(t) for t in prompt[i * bs : (i + 1) * bs]))
+            yield key, i
+
+    def lookup_prefix(self, prompt: Sequence[int]) -> List[int]:
+        """Longest cached chain of full prompt blocks (no refs taken)."""
+        if not self.prefix_cache:
+            return []
+        out: List[int] = []
+        for key, _ in self._chain_keys(prompt):
+            blk = self._cache.get(key)
+            if blk is None:
+                break
+            self._cache.move_to_end(key)
+            out.append(blk)
+        return out
+
+    def admit(
+        self, prompt: Sequence[int], max_new: int
+    ) -> Optional[Admission]:
+        """Reserve the request's full footprint; ``None`` = wait.
+
+        The shared prefix (if any) is refcounted rather than copied; the
+        remaining blocks come from the free list, evicting LRU prefix-cache
+        entries if that is what it takes.  A request whose footprint
+        exceeds the whole pool raises — waiting would never help.
+        """
+        total = self.blocks_needed(len(prompt), max_new)
+        if total > self.num_blocks:
+            raise ValueError(
+                f"request needs {total} blocks but the pool only has "
+                f"{self.num_blocks} (prompt {len(prompt)} + max_new "
+                f"{max_new} @ block_size {self.block_size})"
+            )
+        shared = self.lookup_prefix(prompt)
+        fresh = self._alloc_with_evict(total - len(shared))
+        if fresh is None:
+            return None
+        for b in shared:
+            self._ref[b] += 1
+        for b in fresh:
+            self._ref[b] = 1
+        return Admission(shared + fresh, len(shared), self.block_size)
+
+    def register_prefix(
+        self, prompt: Sequence[int], admission: Admission
+    ) -> None:
+        """Publish this prefill's full prompt blocks for future reuse.
+        First-writer-wins: a chain link another request already registered
+        keeps its block (ours stays private and is freed at release)."""
+        if not self.prefix_cache:
+            return
+        for key, i in self._chain_keys(prompt):
+            if key in self._cache:
+                continue
+            blk = admission.block_ids[i]
+            self._cache[key] = blk
+            self._ref[blk] += 1  # the cache's own reference
+
+    def release(self, admission: Admission) -> None:
+        """Drop the request's references; zero-ref blocks recycle."""
+        for b in admission.block_ids:
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                self._alloc.free([b])
+
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        if n == 0:
+            return []
+        got = self._alloc.alloc(n)
+        if got is not None:
+            return got
+        # reclaim LRU cache entries whose ONLY holder is the cache itself
+        for key in list(self._cache):
+            if self._alloc.num_free >= n:
+                break
+            blk = self._cache[key]
+            if self._ref.get(blk) == 1:
+                del self._cache[key]
+                del self._ref[blk]
+                self._alloc.free([blk])
+                self.prefix_evictions += 1
+        return self._alloc.alloc(n)

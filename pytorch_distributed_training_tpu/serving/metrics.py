@@ -42,14 +42,21 @@ class ServingMetrics:
         self._latency_ms = self._registry.histogram("latency_ms", _RESERVOIR)
         self._batch_size = self._registry.histogram("batch_size", _RESERVOIR)
         self._gen_len = self._registry.histogram("gen_len", _RESERVOIR)
+        # continuous-scheduler shape: per-iteration slot occupancy and
+        # block-pool utilization, both recorded as fractions in [0, 1]
+        self._slot_occ = self._registry.histogram("slot_occupancy", _RESERVOIR)
+        self._block_util = self._registry.histogram("block_util", _RESERVOIR)
         self._items = 0
         self._first_t: Optional[float] = None
         self._last_t: Optional[float] = None
         self._max_depth = 0
-        # LM phase split (round 6): accumulated prefill/decode device seconds
-        # and prompt tokens, so the snapshot can report prefill vs decode
-        # tokens/s separately
-        self._prompt_tokens = 0
+        # LM phase split (round 6): accumulated prefill/decode device
+        # seconds and the tokens each phase is RESPONSIBLE for.  Generated
+        # token 0 is sampled by the prefill program, so it counts as a
+        # prefill token (the attribution fix of PR 7 — it was previously
+        # lumped into decode throughput and documented-not-corrected).
+        self._prefill_tokens = 0
+        self._decode_tokens = 0
         self._prefill_s = 0.0
         self._decode_s = 0.0
 
@@ -87,9 +94,56 @@ class ServingMetrics:
                 self._first_t = now
             self._last_t = now
             self._max_depth = max(self._max_depth, queue_depth)
-            self._prompt_tokens += int(prompt_tokens)
             self._prefill_s += float(prefill_s)
             self._decode_s += float(decode_s)
+            # prefill answers for the real prompt tokens it consumed PLUS
+            # the first generated token of each request (it sampled them);
+            # decode answers for the rest
+            n_req = len(gen_lens) if gen_lens else 0
+            self._prefill_tokens += int(prompt_tokens) + n_req
+            if gen_lens:
+                self._decode_tokens += int(sum(gen_lens)) - n_req
+
+    # ------------------------------------------------------------------ #
+    # continuous-scheduler instruments (serving/scheduler.py): the
+    # scheduler has no "batch" — requests retire one by one and device
+    # time accrues per prefill call / per decode step
+
+    def record_request(self, enqueued_at: float, gen_len: int) -> None:
+        """One RETIRED request: end-to-end latency + generated length."""
+        now = time.monotonic()
+        self._latency_ms.observe((now - enqueued_at) * 1000.0)
+        self._gen_len.observe(int(gen_len))
+        with self._lock:
+            self._items += int(gen_len)
+            if self._first_t is None:
+                self._first_t = now
+            self._last_t = now
+
+    def record_prefill(
+        self, prompt_tokens: int, n_requests: int, prefill_s: float
+    ) -> None:
+        """One prefill call: suffix tokens consumed + token 0 per row."""
+        with self._lock:
+            self._prefill_tokens += int(prompt_tokens) + int(n_requests)
+            self._prefill_s += float(prefill_s)
+
+    def record_decode(self, n_tokens: int, decode_s: float) -> None:
+        """One decode step: tokens sampled across the occupied slots."""
+        with self._lock:
+            self._decode_tokens += int(n_tokens)
+            self._decode_s += float(decode_s)
+
+    def record_iteration(
+        self,
+        active_slots: int,
+        total_slots: int,
+        blocks_in_use: int,
+        total_blocks: int,
+    ) -> None:
+        """Scheduler-state sample at one decode iteration."""
+        self._slot_occ.observe(active_slots / max(total_slots, 1))
+        self._block_util.observe(blocks_in_use / max(total_blocks, 1))
 
     def observe_depth(self, depth: int) -> None:
         with self._lock:
@@ -100,6 +154,8 @@ class ServingMetrics:
         lat = self._latency_ms.snapshot()
         sizes = self._batch_size.snapshot()
         gen = self._gen_len.snapshot()
+        occ = self._slot_occ.snapshot()
+        util = self._block_util.snapshot()
         with self._lock:
             span = (
                 (self._last_t - self._first_t)
@@ -108,7 +164,8 @@ class ServingMetrics:
             )
             items = self._items
             depth = self._max_depth
-            prompt_tokens = self._prompt_tokens
+            prefill_tokens = self._prefill_tokens
+            decode_tokens = self._decode_tokens
             prefill_s = self._prefill_s
             decode_s = self._decode_s
         out = {
@@ -132,14 +189,26 @@ class ServingMetrics:
             out["gen_tokens"] = int(gen["sum"])
             out["gen_len_mean"] = float(gen["mean"])
             out["gen_len_p50"] = float(gen["p50"])
-            # phase rates: prefill consumes real prompt tokens, decode emits
-            # generated tokens (token 0 is sampled by the prefill program —
-            # one token per request of attribution noise, documented rather
-            # than corrected)
-            if prefill_s > 0:
-                out["prefill_tokens_per_sec"] = float(prompt_tokens / prefill_s)
-            if decode_s > 0:
-                out["decode_tokens_per_sec"] = float(gen["sum"] / decode_s)
+        # phase rates: each phase is divided by the tokens it actually
+        # produced/consumed — generated token 0 is a PREFILL token (the
+        # prefill program samples it), the remaining gen tokens are
+        # decode's.  Fixes the round-6 attribution skew that inflated
+        # decode throughput by one token per request.
+        if prefill_s > 0 and prefill_tokens:
+            out["prefill_tokens_per_sec"] = float(prefill_tokens / prefill_s)
+        if decode_s > 0 and decode_tokens:
+            out["decode_tokens_per_sec"] = float(decode_tokens / decode_s)
+        # continuous-scheduler shape (absent on the batcher path)
+        if occ["count"]:
+            out["slot_occupancy_mean"] = float(occ["mean"])
+        if util["count"]:
+            out["block_util_mean"] = float(util["mean"])
+            out["block_util_max"] = float(util["max"])
+        counters = self._registry.counters()
+        hits = counters.get("prefix_hit_blocks", 0)
+        misses = counters.get("prefix_miss_blocks", 0)
+        if hits + misses:
+            out["prefix_hit_rate"] = float(hits / (hits + misses))
         return out
 
     def log_summary(self, logger, prefix: str = "serving") -> Dict[str, float]:

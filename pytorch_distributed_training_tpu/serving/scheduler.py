@@ -1,0 +1,510 @@
+"""Iteration-level (continuous) batching over the paged KV pool.
+
+The :class:`..serving.batcher.DynamicBatcher` schedules at request-batch
+granularity: a batch holds its jit program until every member finishes
+decoding, so one long generation stalls the accelerator for the whole
+group — the pathology PERF.md's serve bench measures directly.  This
+module replaces that with Orca-style iteration-level scheduling (Yu et
+al. OSDI'22) over a vLLM-style paged cache (Kwon et al. SOSP'23,
+serving/kv_pool.py): the decode loop is a HOST-driven step loop over a
+fixed-width slot array, and between single-token steps finished rows are
+retired and their slots refilled from the queue with freshly prefilled
+requests.  A slot never waits on its neighbors.
+
+Compile count stays bounded by construction, exactly like the batcher
+path: every device call has a fixed shape — prefill pads (rows, suffix
+tokens) up to the (batch, seq) bucket grid, and the decode step is ONE
+[slots, 1] program reused forever (inactive slots ride along with
+position -1; their pool scatter drops and their sampled token is ignored
+host-side).  Admitting more traffic changes the CONTENT of those arrays,
+never their shape.
+
+Degradation composes with PR 3's levers: per-request ``deadline_ms``
+expires requests still QUEUED past their deadline (admitted requests run
+to completion — retiring mid-flight would waste the blocks already
+computed), and ``max_backlog`` sheds with the batcher's
+:class:`OverloadedError` after sweeping expired entries out of the depth
+accounting.  Counters flow through :class:`ServingMetrics` and are
+mirrored into the process telemetry registry (``serving_*``) so the
+one-ledger rule holds.
+
+Single-process by design (for now): inputs are handed to jit uncommitted
+rather than sharded over the mesh — multi-host serving stays on the
+batcher path until the scheduler learns sharded block tables.
+
+Determinism for tests: construct with ``start=False`` and drive
+:meth:`tick` by hand — one tick = admit + prefill + one decode step, so a
+scripted arrival trace replays bit-identically.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry.registry import get_registry
+from .batcher import OverloadedError
+from .decode import build_paged_fns
+from .kv_pool import PagedKVPool
+from .metrics import ServingMetrics
+
+__all__ = ["ContinuousScheduler"]
+
+
+class _PagedRequest:
+    """One request's slot-side state: prompt, reservation, token stream."""
+
+    __slots__ = (
+        "prompt", "max_new", "future", "enqueued_at", "deadline",
+        "on_token", "row_key", "admission", "slot", "tokens",
+    )
+
+    def __init__(self, prompt, max_new, deadline, on_token, row_key):
+        self.prompt = prompt  # 1-D np.int32
+        self.max_new = max_new
+        self.future: Future = Future()
+        self.enqueued_at = time.monotonic()
+        self.deadline = deadline  # absolute monotonic, None = forever
+        self.on_token = on_token
+        self.row_key = row_key
+        self.admission = None  # set when a slot admits us
+        self.slot = -1
+        self.tokens: List[int] = []
+
+    @property
+    def gen_idx(self) -> int:
+        """Generated-token count so far == index of the NEXT token."""
+        return len(self.tokens)
+
+
+class ContinuousScheduler:
+    """Slot array + block pool + host step loop.
+
+    ``submit(prompt)`` returns a future resolved with the batcher-path
+    result shape ``{"tokens": int32 [gen_len], "gen_len": int}``; the
+    optional ``on_token`` callback streams each token the moment the host
+    sees it (called on the scheduler thread — keep it cheap).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        slots: int = 8,
+        block_size: int = 16,
+        num_blocks: int = 64,
+        prefix_cache: bool = True,
+        batch_buckets: Sequence[int],
+        seq_buckets: Sequence[int],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        deadline_ms: Optional[float] = None,
+        max_backlog: Optional[int] = None,
+        metrics: Optional[ServingMetrics] = None,
+        seed: int = 0,
+        pool_sharding=None,
+        logger: Optional[logging.Logger] = None,
+        start: bool = True,
+    ):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {deadline_ms}")
+        if max_backlog is not None and max_backlog < 1:
+            raise ValueError(f"max_backlog must be >= 1, got {max_backlog}")
+        self.slots_n = int(slots)
+        self.batch_buckets = sorted(set(int(b) for b in batch_buckets))
+        self.seq_buckets = sorted(set(int(s) for s in seq_buckets))
+        if not self.batch_buckets or not self.seq_buckets:
+            raise ValueError("scheduler needs batch_buckets and seq_buckets")
+        self.max_new_tokens = int(max_new_tokens)
+        worst = self.seq_buckets[-1] + self.max_new_tokens
+        if worst > model.max_len:
+            raise ValueError(
+                f"largest seq bucket {self.seq_buckets[-1]} + max_new_tokens "
+                f"{self.max_new_tokens} = {worst} exceeds model max_len "
+                f"{model.max_len}"
+            )
+        self.eos_id = eos_id
+        self.deadline_ms = deadline_ms
+        self.max_backlog = max_backlog
+        self.logger = logger or logging.getLogger(__name__)
+        self.metrics = metrics or ServingMetrics()
+
+        self._kv = PagedKVPool(num_blocks, block_size, prefix_cache)
+        # every block table is padded to the worst-case footprint so the
+        # decode program's shape never depends on a request's length
+        self.table_blocks = self._kv.blocks_needed(
+            self.seq_buckets[-1], self.max_new_tokens
+        )
+        if self.table_blocks > self._kv.num_blocks:
+            raise ValueError(
+                f"worst-case request needs {self.table_blocks} blocks but "
+                f"num_blocks is {self._kv.num_blocks}; grow the pool or "
+                "shrink seq_buckets/max_new_tokens"
+            )
+        self._fns = build_paged_fns(
+            model, block_size, num_blocks, temperature=temperature
+        )
+        self.params = params
+        self._pool = self._fns.init_pool(params)
+        if pool_sharding is not None:
+            # land the initial pool under the same sharding jit will give
+            # the UPDATED pool, or the second call of each prefill shape
+            # recompiles for the sharding change (engine passes the mesh's
+            # replicated sharding; plain single-device use needs nothing)
+            self._pool = jax.device_put(self._pool, pool_sharding)
+        self._pad_key = jax.random.PRNGKey(0)
+        self._base_rng = jax.random.PRNGKey(int(seed))
+        self._seq_no = 0
+
+        self._slots: List[Optional[_PagedRequest]] = [None] * self.slots_n
+        self._queue: "deque[_PagedRequest]" = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-scheduler", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------------ #
+    # client side
+
+    def submit(
+        self,
+        prompt,
+        deadline_ms: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        rng=None,
+    ) -> Future:
+        """Enqueue one prompt; the future resolves at retirement.
+
+        ``max_new_tokens`` caps THIS request below the scheduler-wide
+        budget (its slot retires early instead of padding the batch with
+        dead decode steps — the whole point of iteration-level
+        scheduling); ``rng`` overrides the request's sampling key (a
+        PRNGKey) so tests can replay the whole-batch path row for row.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D token sequence, got shape "
+                f"{prompt.shape}"
+            )
+        if prompt.size > self.seq_buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds largest seq bucket "
+                f"{self.seq_buckets[-1]}"
+            )
+        mnt = self.max_new_tokens if max_new_tokens is None else int(max_new_tokens)
+        if not 1 <= mnt <= self.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self.max_new_tokens}], got {mnt}"
+            )
+        dl = deadline_ms if deadline_ms is not None else self.deadline_ms
+        if dl is not None and dl <= 0:
+            raise ValueError(f"deadline_ms must be > 0, got {dl}")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("scheduler is closed")
+            # sweep expired entries BEFORE the backlog check so live
+            # requests are never shed to protect doomed ones (the
+            # DynamicBatcher bug this PR also fixes)
+            self._sweep_expired_locked()
+            if (
+                self.max_backlog is not None
+                and len(self._queue) >= self.max_backlog
+            ):
+                self._bump("sheds")
+                raise OverloadedError(
+                    f"serving backlog full ({self.max_backlog} waiting); "
+                    "request shed"
+                )
+            if rng is None:
+                rng = jax.random.fold_in(self._base_rng, self._seq_no)
+                self._seq_no += 1
+            req = _PagedRequest(
+                prompt, mnt,
+                deadline=(time.monotonic() + dl / 1000.0) if dl else None,
+                on_token=on_token, row_key=rng,
+            )
+            self._queue.append(req)
+            self.metrics.observe_depth(len(self._queue))
+            self._cond.notify_all()
+        return req.future
+
+    def depth(self) -> int:
+        """Requests queued but not yet admitted to a slot."""
+        with self._cond:
+            return len(self._queue)
+
+    def active(self) -> int:
+        """Slots currently decoding."""
+        return sum(1 for s in self._slots if s is not None)
+
+    def compile_count(self) -> int:
+        """Distinct XLA programs compiled so far: bounded by the prefill
+        bucket grid + the single decode-step program, whatever traffic
+        does."""
+        return self._fns._cache_size()
+
+    def close(self) -> None:
+        """Drain queue and in-flight slots, then stop the loop."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+        else:
+            # test mode (start=False): drain synchronously
+            while self.tick():
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # scheduler side — everything below runs on ONE thread (the loop, or
+    # the test driving tick() by hand), which is what lets kv_pool.py go
+    # lock-free
+
+    def tick(self) -> bool:
+        """One scheduler iteration: admit+prefill, then one decode step.
+
+        Returns True if any work happened (the synchronous drain in
+        ``close`` loops on it).
+        """
+        newly = self._admit()
+        if newly:
+            self._prefill(newly)
+        n_active = self.active()
+        if n_active:
+            self._decode_step()
+        return bool(newly) or n_active > 0
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        """Engine-local AND process-global: the snapshot shows the
+        engine's own counts, the telemetry registry the fleet view."""
+        self.metrics.incr(name, n)
+        get_registry().counter(f"serving_{name}").inc(n)
+
+    def _expire(self, req: _PagedRequest, now: float) -> bool:
+        if req.deadline is None or now < req.deadline:
+            return False
+        self._bump("timeouts")
+        if not req.future.done():
+            req.future.set_exception(
+                TimeoutError(
+                    "serving request exceeded its deadline after "
+                    f"{now - req.enqueued_at:.3f}s in queue"
+                )
+            )
+        return True
+
+    def _sweep_expired_locked(self) -> None:
+        now = time.monotonic()
+        if any(r.deadline is not None and now >= r.deadline for r in self._queue):
+            self._queue = deque(
+                r for r in self._queue if not self._expire(r, now)
+            )
+
+    def _admit(self) -> List[_PagedRequest]:
+        """Fill free slots from the queue head (FCFS: a head request the
+        pool cannot cover blocks those behind it — no starvation, at the
+        cost of head-of-line blocking; counted as ``admission_waits``)."""
+        newly: List[_PagedRequest] = []
+        with self._cond:
+            self._sweep_expired_locked()
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            # one prefill call per tick: cap admissions at the largest
+            # batch bucket so the call stays on the compiled grid
+            max_admit = min(len(free), self.batch_buckets[-1])
+            while self._queue and len(newly) < max_admit:
+                req = self._queue[0]
+                adm = self._kv.admit(req.prompt.tolist(), req.max_new)
+                if adm is None:
+                    self._bump("admission_waits")
+                    break
+                self._queue.popleft()
+                req.admission = adm
+                req.slot = free[len(newly)]
+                self._slots[req.slot] = req
+                newly.append(req)
+                self._bump("admitted")
+                cacheable = (req.prompt.size - 1) // self._kv.block_size
+                if adm.n_shared:
+                    self._bump("prefix_hit_blocks", adm.n_shared)
+                if cacheable - adm.n_shared:
+                    self._bump("prefix_miss_blocks", cacheable - adm.n_shared)
+        return newly
+
+    def _bucket_for(self, n: int, buckets: Sequence[int], kind: str) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"{kind} {n} exceeds largest bucket {buckets[-1]}")
+
+    def _prefill(self, newly: List[_PagedRequest]) -> None:
+        """One bucketed prefill over every request admitted this tick.
+
+        Prefix-cache hits shorten the device work directly: only the
+        SUFFIX past ``cached_len`` is fed (positions ``cached_len ..
+        prompt_len-1``), padded up to a seq bucket.
+        """
+        t0 = time.perf_counter()
+        suffix = [r.prompt.size - r.admission.cached_len for r in newly]
+        bb = self._bucket_for(len(newly), self.batch_buckets, "admitted rows")
+        sb = self._bucket_for(max(suffix), self.seq_buckets, "prefill suffix")
+        tokens = np.zeros((bb, sb), np.int32)
+        positions = np.full((bb, sb), -1, np.int32)
+        tables = np.zeros((bb, self.table_blocks), np.int32)
+        last_col = np.zeros((bb,), np.int32)
+        keys = [self._pad_key] * bb
+        for i, req in enumerate(newly):
+            cl = req.admission.cached_len
+            tokens[i, : suffix[i]] = req.prompt[cl:]
+            positions[i, : suffix[i]] = np.arange(cl, req.prompt.size)
+            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            last_col[i] = suffix[i] - 1
+            keys[i] = req.row_key
+        tok, self._pool = self._fns.prefill(
+            self.params, self._pool, tokens, positions, tables,
+            last_col, jnp.stack(keys),
+        )
+        tok = np.asarray(tok)
+        t1 = time.perf_counter()
+        for i, req in enumerate(newly):
+            # blocks are filled now — publish them for future prefix hits
+            # BEFORE this request can retire and release them
+            self._kv.register_prefix(req.prompt.tolist(), req.admission)
+            self._push_token(req, int(tok[i]))
+        self.metrics.record_prefill(
+            prompt_tokens=int(sum(suffix)), n_requests=len(newly),
+            prefill_s=t1 - t0,
+        )
+
+    def _decode_step(self) -> None:
+        """One single-token step for every occupied slot."""
+        t0 = time.perf_counter()
+        W = self.slots_n
+        prev = np.zeros((W,), np.int32)
+        pos = np.full((W,), -1, np.int32)
+        tables = np.zeros((W, self.table_blocks), np.int32)
+        gen_idx = np.zeros((W,), np.int32)
+        keys = [self._pad_key] * W
+        active = []
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            active.append(req)
+            prev[i] = req.tokens[-1]
+            # prev = generated token gen_idx-1 at global position
+            # prompt_len + gen_idx - 1; feeding it samples token gen_idx
+            pos[i] = req.prompt.size + req.gen_idx - 1
+            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            gen_idx[i] = req.gen_idx
+            keys[i] = req.row_key
+        n_active = len(active)
+        tok, self._pool = self._fns.decode_step(
+            self.params, self._pool, prev, pos, tables,
+            jnp.stack(keys), gen_idx,
+        )
+        tok = np.asarray(tok)
+        t1 = time.perf_counter()
+        for req in active:
+            self._push_token(req, int(tok[req.slot]))
+        self.metrics.record_decode(n_tokens=n_active, decode_s=t1 - t0)
+        self.metrics.record_iteration(
+            active_slots=n_active, total_slots=W,
+            blocks_in_use=self._kv.blocks_in_use,
+            total_blocks=self._kv.num_blocks,
+        )
+
+    def _push_token(self, req: _PagedRequest, tok: int) -> None:
+        req.tokens.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:  # a client callback must not kill the loop
+                self.logger.exception("on_token callback raised; ignoring")
+        if (self.eos_id is not None and tok == self.eos_id) or (
+            req.gen_idx >= req.max_new
+        ):
+            self._retire(req)
+
+    def _retire(self, req: _PagedRequest) -> None:
+        self._slots[req.slot] = None
+        self._kv.release(req.admission)
+        req.admission = None
+        if not req.future.done():
+            req.future.set_result(
+                {
+                    "tokens": np.asarray(req.tokens, np.int32),
+                    "gen_len": len(req.tokens),
+                }
+            )
+        self._bump("retired")
+        self.metrics.record_request(req.enqueued_at, gen_len=len(req.tokens))
+        if self._kv.prefix_evictions:
+            # drain the pool's eviction tally into the ledger (the pool
+            # itself is metrics-free bookkeeping)
+            self._bump("prefix_evictions", self._kv.prefix_evictions)
+            self._kv.prefix_evictions = 0
+
+    def _fail_inflight(self, exc: BaseException) -> None:
+        """A device error poisons every in-flight request (their pool
+        state is unknown); queued requests are failed too rather than
+        retried into the same error."""
+        with self._cond:
+            doomed = [s for s in self._slots if s is not None]
+            doomed.extend(self._queue)
+            self._queue.clear()
+            self._slots = [None] * self.slots_n
+        for req in doomed:
+            if req.admission is not None:
+                self._kv.release(req.admission)
+                req.admission = None
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not (
+                    self._closed
+                    or self._queue
+                    or any(s is not None for s in self._slots)
+                ):
+                    self._cond.wait()
+                if (
+                    self._closed
+                    and not self._queue
+                    and all(s is None for s in self._slots)
+                ):
+                    return
+            try:
+                self.tick()
+            except BaseException as exc:  # keep the loop alive
+                self.logger.exception("scheduler tick failed")
+                self._fail_inflight(exc)

@@ -18,10 +18,12 @@ import pathlib
 
 
 # Anything that runs a bench — shelling out to bench.py OR calling a bench
-# entry point in-process (import bench / bench_ckpt() / bench_chaos(), the
-# ckpt-overlap and chaos modes both train real models) — pays compiles and
-# timed windows and must not ride the default tier.
-_BENCH_DRIVERS = ("bench.py", "import bench", "bench_ckpt(", "bench_chaos(")
+# entry point in-process (import bench / bench_ckpt() / bench_chaos() /
+# bench_serve(), which compile real models and run timed windows) — pays
+# compiles and timed windows and must not ride the default tier.
+_BENCH_DRIVERS = (
+    "bench.py", "import bench", "bench_ckpt(", "bench_chaos(", "bench_serve(",
+)
 
 
 def test_bench_driving_tests_are_slow_marked():
@@ -156,3 +158,23 @@ def test_no_ad_hoc_counter_stores_outside_telemetry():
         "telemetry.registry (get_registry().counter(name) or a private "
         f"MetricsRegistry for instance-local counts): {offenders}"
     )
+
+
+def test_counter_guard_covers_new_serving_modules():
+    """PR 7 added serving/scheduler.py and serving/kv_pool.py; pin that
+    the package-wide counter-store scan actually reaches them (the guard
+    above globs the package tree, so a rename/move that drops them out of
+    scope should fail HERE, not silently stop scanning) and that their
+    counters route through ServingMetrics / the telemetry registry."""
+    pkg = pathlib.Path(__file__).parent.parent / "pytorch_distributed_training_tpu"
+    for rel in ("serving/scheduler.py", "serving/kv_pool.py"):
+        path = pkg / rel
+        assert path.exists(), f"{rel} moved — update the convention guards"
+        assert path in set(pkg.rglob("*.py")), f"{rel} escaped the scan"
+        tree = ast.parse(path.read_text())
+        assert not [
+            node.lineno for node in ast.walk(tree) if _is_counter_store(node)
+        ], f"{rel} grew an ad-hoc counter store"
+    # the scheduler must talk to the ledger, not keep private tallies
+    sched_src = (pkg / "serving/scheduler.py").read_text()
+    assert "metrics.incr" in sched_src and "get_registry" in sched_src

@@ -373,8 +373,11 @@ def test_metrics_phase_split_and_gen_lens():
     assert snap["gen_tokens"] == 9
     assert snap["gen_len_mean"] == pytest.approx(3.0)
     assert snap["gen_len_p50"] == pytest.approx(3.0)
-    assert snap["prefill_tokens_per_sec"] == pytest.approx(25 / 0.02)
-    assert snap["decode_tokens_per_sec"] == pytest.approx(9 / 0.08)
+    # PR 7 attribution fix: generated token 0 of each request is SAMPLED
+    # BY THE PREFILL PROGRAM, so it counts toward prefill throughput (3
+    # requests -> +3 prefill tokens) and not decode's (9 gen - 3)
+    assert snap["prefill_tokens_per_sec"] == pytest.approx((25 + 3) / 0.02)
+    assert snap["decode_tokens_per_sec"] == pytest.approx((9 - 3) / 0.08)
     # image-path batches (no gen_lens) must not emit the LM-only fields
     m2 = ServingMetrics()
     m2.record_batch([now], n_items=4)
@@ -444,3 +447,464 @@ serving:
     # 2 per exercised bucket cell since the prefill/decode phase split
     assert snap["compile_count"] <= 4
     assert snap["latency_ms_p50"] > 0
+
+
+# --------------------------------------------------------------------- #
+# PR 7: paged KV pool — block allocator
+
+
+def test_block_allocator_alloc_free_recycle():
+    from pytorch_distributed_training_tpu.serving.kv_pool import BlockAllocator
+
+    a = BlockAllocator(num_blocks=4, block_size=8)
+    assert a.num_free == 4
+    got = a.alloc(3)
+    assert sorted(got) == [0, 1, 2] and a.num_free == 1
+    assert a.alloc(0) == []
+    assert a.alloc(2) is None  # exhaustion: all-or-nothing, no partial grant
+    assert a.num_free == 1  # failed alloc took nothing
+    a.free([1])
+    # LIFO recycling: the just-freed block is re-issued first
+    assert a.alloc(1) == [1]
+    with pytest.raises(ValueError, match="double free"):
+        a.free([3, 3])
+
+
+def test_paged_pool_admission_control_and_refcounts():
+    from pytorch_distributed_training_tpu.serving.kv_pool import PagedKVPool
+
+    pool = PagedKVPool(num_blocks=4, block_size=4, prefix_cache=False)
+    # plen 8 + max_new 4 = 12 tokens -> 3 blocks
+    a1 = pool.admit(list(range(8)), 4)
+    assert a1 is not None and len(a1.block_ids) == 3 and a1.n_shared == 0
+    assert pool.blocks_in_use == 3
+    # second identical footprint cannot fit -> wait (None), NEVER an OOM
+    assert pool.admit(list(range(100, 108)), 4) is None
+    assert pool.blocks_in_use == 3  # failed admit leaked nothing
+    pool.release(a1)
+    assert pool.blocks_in_use == 0
+    a2 = pool.admit(list(range(100, 108)), 4)
+    assert a2 is not None
+    # a footprint larger than the whole pool can never be satisfied
+    with pytest.raises(ValueError, match="only has"):
+        pool.admit(list(range(16)), 4)
+
+
+def test_paged_pool_prefix_cache_reuse_and_eviction():
+    from pytorch_distributed_training_tpu.serving.kv_pool import PagedKVPool
+
+    pool = PagedKVPool(num_blocks=6, block_size=4, prefix_cache=True)
+    prompt = list(range(9))  # 2 full cacheable blocks ((9-1)//4)
+    a1 = pool.admit(prompt, 3)  # 3 blocks total
+    assert a1.n_shared == 0
+    pool.register_prefix(prompt, a1)
+    pool.release(a1)
+    # request blocks freed, but the 2 cacheable ones stay held by the cache
+    assert pool.blocks_in_use == 2
+    a2 = pool.admit(prompt, 3)
+    assert a2.n_shared == 2 and a2.cached_len == 8
+    # shared blocks are the SAME physical blocks, not copies
+    assert a2.block_ids[:2] == a1.block_ids[:2]
+    pool.release(a2)
+    # a big unrelated request forces LRU eviction of the cache-only blocks
+    a3 = pool.admit(list(range(50, 66)), 8)  # 6 blocks = whole pool
+    assert a3 is not None and pool.prefix_evictions == 2
+    assert pool.lookup_prefix(prompt) == []  # evicted -> cold again
+    pool.release(a3)
+    assert pool.blocks_in_use == 0
+
+
+# --------------------------------------------------------------------- #
+# PR 7: paged attention — decode parity + bitwise prefix-hit oracle
+
+
+def test_paged_prefill_prefix_hit_bitwise_logits(lm_and_params):
+    """A warm (prefix-hit) suffix prefill must produce BITWISE-identical
+    logits to the cold full-prompt prefill at the overlapping positions:
+    the gathered pool K/V is the same bytes in the same logical order, and
+    per-position layers cannot see batch composition."""
+    from pytorch_distributed_training_tpu.serving.decode import build_paged_fns
+
+    model, params = lm_and_params
+    fns = build_paged_fns(model, block_size=4, num_blocks=8)
+    paged = model.clone(
+        decode=True, paged=True, kv_block_size=4, kv_num_blocks=8
+    )
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, VOCAB, 7).astype(np.int32)  # 1 cacheable block
+
+    pool0 = fns.init_pool(params)
+    cold_logits, v = paged.apply(
+        {"params": params, "cache": pool0},
+        jnp.asarray(prompt[None]),
+        jnp.arange(7, dtype=jnp.int32)[None],
+        jnp.asarray([[0, 1]], jnp.int32),
+        mutable=["cache"],
+    )
+    # warm: block 0 (positions 0..3) already filled by the pass above is
+    # shared read-only; the suffix runs against a FRESH physical block
+    warm_logits, _ = paged.apply(
+        {"params": params, "cache": v["cache"]},
+        jnp.asarray(prompt[None, 4:]),
+        jnp.arange(4, 7, dtype=jnp.int32)[None],
+        jnp.asarray([[0, 3]], jnp.int32),
+        mutable=["cache"],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(warm_logits[0]), np.asarray(cold_logits[0, 4:])
+    )
+
+
+def _run_scheduler_to_done(sched, futures, limit=200):
+    n = 0
+    while any(not f.done() for f in futures):
+        sched.tick()
+        n += 1
+        assert n < limit, "scheduler failed to drain"
+    return n
+
+
+def test_scheduler_greedy_parity_with_contiguous(lm_and_params):
+    """Acceptance oracle: the paged scheduler reproduces the contiguous
+    whole-batch path token for token (greedy)."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+    max_new = 6
+    gen = build_generate_fn(model, max_new_tokens=max_new, temperature=0.0,
+                            eos_id=1)
+    rng = np.random.default_rng(3)
+    lens = [2, 6, 4]
+    toks = np.zeros((3, 8), np.int32)
+    rows = []
+    for i, ln in enumerate(lens):
+        rows.append(rng.integers(2, VOCAB, ln).astype(np.int32))
+        toks[i, :ln] = rows[i]
+    out, gl = gen(params, jnp.asarray(toks), jnp.asarray(lens, jnp.int32),
+                  jax.random.PRNGKey(7))
+    out, gl = np.asarray(out), np.asarray(gl)
+
+    sched = ContinuousScheduler(
+        model, params, slots=4, block_size=4, num_blocks=16,
+        batch_buckets=[4], seq_buckets=[8], max_new_tokens=max_new,
+        temperature=0.0, eos_id=1, start=False,
+    )
+    futs = [sched.submit(rows[i]) for i in range(3)]
+    _run_scheduler_to_done(sched, futs)
+    for i, f in enumerate(futs):
+        res = f.result()
+        assert res["gen_len"] == gl[i]
+        np.testing.assert_array_equal(res["tokens"], out[i, : gl[i]])
+
+
+def test_scheduler_sampled_parity_with_contiguous(lm_and_params):
+    """Sampled mode: per-row per-token-index keys make a row's draw
+    independent of batch composition, so the scheduler (re-batching rows
+    every step) still matches the whole-batch path token for token."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+    max_new = 6
+    gen = build_generate_fn(model, max_new_tokens=max_new, temperature=0.8,
+                            eos_id=1)
+    rng = np.random.default_rng(3)
+    lens = [2, 6, 4]
+    toks = np.zeros((3, 8), np.int32)
+    rows = []
+    for i, ln in enumerate(lens):
+        rows.append(rng.integers(2, VOCAB, ln).astype(np.int32))
+        toks[i, :ln] = rows[i]
+    R = jax.random.PRNGKey(7)
+    out, gl = gen(params, jnp.asarray(toks), jnp.asarray(lens, jnp.int32), R)
+    out, gl = np.asarray(out), np.asarray(gl)
+
+    sched = ContinuousScheduler(
+        model, params, slots=4, block_size=4, num_blocks=16,
+        batch_buckets=[4], seq_buckets=[8], max_new_tokens=max_new,
+        temperature=0.8, eos_id=1, start=False,
+    )
+    # row r of the whole-batch call draws with fold_in(R, r)
+    futs = [
+        sched.submit(rows[i], rng=jax.random.fold_in(R, i)) for i in range(3)
+    ]
+    _run_scheduler_to_done(sched, futs)
+    for i, f in enumerate(futs):
+        res = f.result()
+        assert res["gen_len"] == gl[i]
+        np.testing.assert_array_equal(res["tokens"], out[i, : gl[i]])
+
+
+def test_scheduler_retire_and_refill_deterministic(lm_and_params):
+    """Scripted arrival trace: a short request retires mid-flight and its
+    slot is refilled from the queue while the long one keeps decoding;
+    replaying the trace gives bit-identical streams and tick counts."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+    rng = np.random.default_rng(5)
+    p_long = rng.integers(2, VOCAB, 6).astype(np.int32)
+    p_short = rng.integers(2, VOCAB, 3).astype(np.int32)
+    p_queued = rng.integers(2, VOCAB, 4).astype(np.int32)
+
+    def run_trace():
+        sched = ContinuousScheduler(
+            model, params, slots=2, block_size=4, num_blocks=16,
+            batch_buckets=[2], seq_buckets=[8], max_new_tokens=6,
+            temperature=0.0, eos_id=None, start=False,
+        )
+        f_long = sched.submit(p_long)                      # 6 tokens
+        f_short = sched.submit(p_short, max_new_tokens=2)  # retires early
+        f_queued = sched.submit(p_queued)                  # waits for a slot
+        events = []
+        ticks = 0
+        while any(not f.done() for f in (f_long, f_short, f_queued)):
+            sched.tick()
+            ticks += 1
+            events.append(
+                (sched.active(), f_long.done(), f_short.done(),
+                 f_queued.done())
+            )
+            assert ticks < 100
+        # the short row retired first and the queued request was admitted
+        # BEFORE the long one finished — iteration-level refill: some tick
+        # after the short retirement runs with BOTH slots live again
+        assert any(
+            e[2] and not e[1] and e[0] == 2 for e in events
+        ), "freed slot was not refilled mid-flight"
+        results = tuple(
+            (f.result()["gen_len"], f.result()["tokens"].tolist())
+            for f in (f_long, f_short, f_queued)
+        )
+        snap = sched.metrics.snapshot()
+        return ticks, events, results, snap
+
+    t1, e1, r1, s1 = run_trace()
+    t2, e2, r2, s2 = run_trace()
+    assert (t1, e1, r1) == (t2, e2, r2)
+    assert r1[1][0] == 2  # per-request max_new honored by early retire
+    assert s1["retired"] == 3 and s1["admitted"] == 3
+    assert 0 < s1["slot_occupancy_mean"] <= 1.0
+    assert s1["block_util_max"] <= 1.0
+
+
+def test_scheduler_admission_waits_instead_of_oom(lm_and_params):
+    """Pool exhaustion parks the queue head until blocks free up — the
+    request waits, the pool never over-commits."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+    rng = np.random.default_rng(6)
+    # each request: plen 8 + max_new 4 = 12 tokens -> 3 blocks of a
+    # 4-block pool, so two can never be resident together
+    sched = ContinuousScheduler(
+        model, params, slots=2, block_size=4, num_blocks=4,
+        prefix_cache=False,
+        batch_buckets=[2], seq_buckets=[8], max_new_tokens=4,
+        temperature=0.0, eos_id=None, start=False,
+    )
+    f1 = sched.submit(rng.integers(2, VOCAB, 8).astype(np.int32))
+    f2 = sched.submit(rng.integers(2, VOCAB, 8).astype(np.int32))
+    _run_scheduler_to_done(sched, [f1, f2])
+    assert f1.result()["gen_len"] == 4
+    assert f2.result()["gen_len"] == 4
+    snap = sched.metrics.snapshot()
+    assert snap["admission_waits"] >= 1
+    assert sched._kv.blocks_in_use == 0  # everything recycled
+
+
+def test_scheduler_streams_tokens_and_mirrors_telemetry(lm_and_params):
+    """on_token sees every token in order, and scheduler counters are
+    mirrored into the process telemetry registry (serving_* prefix)."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+    from pytorch_distributed_training_tpu.telemetry.registry import (
+        get_registry,
+    )
+
+    model, params = lm_and_params
+    before = get_registry().counters().get("serving_retired", 0)
+    sched = ContinuousScheduler(
+        model, params, slots=2, block_size=4, num_blocks=16,
+        batch_buckets=[2], seq_buckets=[8], max_new_tokens=4,
+        temperature=0.0, eos_id=None, start=False,
+    )
+    seen = []
+    fut = sched.submit(
+        np.asarray([5, 9, 13], np.int32), on_token=seen.append
+    )
+    _run_scheduler_to_done(sched, [fut])
+    res = fut.result()
+    assert seen == res["tokens"].tolist()
+    assert get_registry().counters()["serving_retired"] == before + 1
+
+
+def test_scheduler_background_loop_and_deadline(lm_and_params):
+    """The threaded loop drains submissions without manual ticks; an
+    impossible queue deadline resolves with TimeoutError."""
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+    with ContinuousScheduler(
+        model, params, slots=2, block_size=4, num_blocks=16,
+        batch_buckets=[2], seq_buckets=[8], max_new_tokens=3,
+        temperature=0.0, eos_id=None,
+    ) as sched:
+        futs = [
+            sched.submit(np.asarray([3 + i, 7], np.int32)) for i in range(5)
+        ]
+        for f in futs:
+            assert f.result(timeout=60)["gen_len"] == 3
+    with pytest.raises(RuntimeError, match="closed"):
+        sched.submit(np.asarray([1], np.int32))
+
+
+# --------------------------------------------------------------------- #
+# PR 7: engine integration — scheduler path, compile-count bound
+
+
+@pytest.fixture(scope="module")
+def sched_engine():
+    from pytorch_distributed_training_tpu.serving.engine import InferenceEngine
+
+    cfg = {
+        "dataset": {"name": "synthetic_text", "n_classes": VOCAB},
+        "model": {
+            "name": "TransformerLM",
+            "embed_dim": 32,
+            "depth": 2,
+            "num_heads": 4,
+            "max_len": 32,
+        },
+        "serving": {
+            "dtype": "float32",
+            "max_batch_size": 4,
+            "max_delay_ms": 2,
+            "batch_buckets": [4],
+            "seq_buckets": [8, 16],
+            "max_new_tokens": 4,
+            "temperature": 0.0,
+            "scheduler": {
+                "enabled": True,
+                "slots": 4,
+                "block_size": 4,
+                "num_blocks": 32,
+                "prefix_cache": True,
+            },
+        },
+    }
+    with InferenceEngine.from_config(cfg) as engine:
+        yield engine
+
+
+def test_engine_scheduler_compile_count_independent_of_requests(sched_engine):
+    """The XLA program count is pinned by the bucket grid + ONE decode
+    step program no matter how many requests stream through."""
+    rng = np.random.default_rng(0)
+    futures = [
+        sched_engine.submit(rng.integers(0, VOCAB, ln).astype(np.int32))
+        for ln in (1, 3, 5, 8, 9, 11, 14, 16, 2, 13, 6, 16, 1, 7)
+    ]
+    results = [f.result(timeout=120) for f in futures]
+    for res in results:
+        assert 1 <= res["gen_len"] <= 4
+        assert res["tokens"].shape == (res["gen_len"],)
+    count_now = sched_engine.compile_count()
+    # 1 batch bucket x 2 seq buckets prefill programs + 1 decode-step
+    # program: <= 3 ever
+    assert count_now <= 3
+    # MORE traffic (fresh lengths, repeat lengths) must not add programs
+    futures = [
+        sched_engine.submit(rng.integers(0, VOCAB, ln).astype(np.int32))
+        for ln in (4, 10, 12, 15, 3, 8)
+    ]
+    for f in futures:
+        f.result(timeout=120)
+    assert sched_engine.compile_count() == count_now
+    snap = sched_engine.metrics.snapshot()
+    assert snap["retired"] == 20
+    assert "slot_occupancy_mean" in snap
+
+
+def test_engine_scheduler_per_request_max_new_and_streaming(sched_engine):
+    seen = []
+    fut = sched_engine.submit(
+        np.asarray([4, 8, 15], np.int32), max_new_tokens=2,
+        on_token=seen.append,
+    )
+    res = fut.result(timeout=60)
+    assert res["gen_len"] <= 2
+    assert seen == res["tokens"].tolist()
+
+
+def test_engine_batcher_path_truncates_per_request_cap(lm_engine):
+    """On the legacy batcher path the per-request cap truncates host-side
+    (the batch still pays the full decode — the pathology the scheduler
+    removes); streaming/rng need the scheduler and fail loudly."""
+    fut = lm_engine.submit(np.asarray([4, 8, 15], np.int32), max_new_tokens=2)
+    res = fut.result(timeout=60)
+    assert res["gen_len"] <= 2
+    assert res["tokens"].shape == (res["gen_len"],)
+    with pytest.raises(ValueError, match="scheduler"):
+        lm_engine.submit(np.asarray([4], np.int32), on_token=lambda t: None)
+
+
+# --------------------------------------------------------------------- #
+# PR 7: batcher backlog no longer counts expired requests
+
+
+def test_batcher_backlog_sweeps_expired_before_shedding():
+    """Doomed (past-deadline) requests sitting in the queue must not eat
+    the backlog budget: submit sweeps them out before the depth check, so
+    a live request is admitted where it previously shed."""
+    from pytorch_distributed_training_tpu.serving.batcher import (
+        OverloadedError,
+    )
+
+    release = threading.Event()
+
+    def run(reqs):
+        release.wait(timeout=10)  # pin the flush thread on the 1st batch
+        return [r.payload for r in reqs]
+
+    b = DynamicBatcher(
+        run, max_batch_size=1, max_delay_ms=1, max_backlog=2
+    )
+    try:
+        f0 = b.submit("head")  # occupies the flush thread
+        time.sleep(0.05)  # let the loop pick f0 up, emptying the queue
+        doomed = [b.submit(i, deadline_ms=10) for i in range(2)]
+        # backlog now "full" of requests that are already dead on arrival
+        time.sleep(0.05)
+        live = b.submit("live")  # old code: OverloadedError here
+        release.set()
+        assert f0.result(timeout=5) == "head"
+        assert live.result(timeout=5) == "live"
+        for f in doomed:
+            with pytest.raises(TimeoutError):
+                f.result(timeout=5)
+        assert b.timeouts == 2
+        # shedding still works against a backlog of LIVE requests
+        release.clear()
+        g0 = b.submit("head2")
+        time.sleep(0.05)
+        keep = [b.submit(i) for i in range(2)]
+        with pytest.raises(OverloadedError):
+            b.submit("overflow")
+        release.set()
+        g0.result(timeout=5)
+        for f in keep:
+            f.result(timeout=5)
+    finally:
+        release.set()
+        b.close()
