@@ -1502,6 +1502,34 @@ def bench_chaos_multihost():
     )
 
 
+def bench_lint():
+    """Run pdt-analyze over the package tree; one-line JSON verdict.
+
+    No device, no compile cache, no JAX execution — the analyzer only
+    parses source.  Exit status mirrors the CLI: 0 clean, 1 findings.
+    """
+    from pytorch_distributed_training_tpu import analysis
+
+    result = analysis.run()
+    print(
+        json.dumps(
+            {
+                "metric": "pdt-analyze unsuppressed findings over the package tree",
+                "value": len(result.unsuppressed),
+                "unit": "findings",
+                "by_rule": result.rule_totals("unsuppressed"),
+                "suppressed": len(result.suppressed),
+                "files_scanned": result.files_scanned,
+                "wall_s": round(result.wall_s, 3),
+            }
+        )
+    )
+    if result.unsuppressed:
+        for f in result.unsuppressed:
+            print(f.format(), file=sys.stderr)
+        sys.exit(1)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("BENCH_MODE", "step")
     # Chaos mode measures recovery correctness, not compile latency, and a
@@ -1509,9 +1537,14 @@ if __name__ == "__main__":
     # path has produced corrupted restores (heap corruption, non-finite
     # params) on vanilla jaxlib CPU builds — fresh compiles unless the
     # cache is explicitly requested via BENCH_COMPILE_CACHE=<dir>.
-    if mode not in ("chaos", "--chaos") or os.environ.get("BENCH_COMPILE_CACHE"):
+    # lint never executes JAX, so the cache would be pure startup cost
+    if mode not in ("chaos", "--chaos", "lint") or os.environ.get(
+        "BENCH_COMPILE_CACHE"
+    ):
         _enable_compile_cache()
-    if mode == "loader":
+    if mode == "lint":
+        bench_lint()
+    elif mode == "loader":
         bench_loader()
     elif mode == "e2e":
         bench_e2e()

@@ -1,0 +1,99 @@
+"""pdt-analyze: static analysis for trace purity, lock discipline,
+collective order, donation safety, and repo conventions.
+
+The analyzer itself is stdlib-only and never executes the code it
+inspects (a purity checker that imported its targets would trigger the
+side effects it polices).  See RULES.md (next to this file)
+for the rule catalogue and suppression syntax, and
+``python -m pytorch_distributed_training_tpu.analysis --help`` for the
+CLI.
+
+Programmatic entry point::
+
+    from pytorch_distributed_training_tpu import analysis
+    result = analysis.run()           # all passes over the package tree
+    assert not result.unsuppressed
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .collectives import CollectiveOrderPass, extract_collective_sequences
+from .conventions import MarkerConventionPass
+from .core import (
+    AnalysisContext,
+    AnalysisPass,
+    AnalysisResult,
+    Finding,
+    SourceModule,
+    collect_modules,
+    load_baseline,
+    run_passes,
+    write_baseline,
+)
+from .donation import DonationSafetyPass
+from .locks import LockDisciplinePass
+from .purity import TracePurityPass
+from .report import json_payload, render_json, render_text
+
+__all__ = [
+    "ALL_PASSES",
+    "AnalysisContext",
+    "AnalysisPass",
+    "AnalysisResult",
+    "Finding",
+    "SourceModule",
+    "collect_modules",
+    "extract_collective_sequences",
+    "json_payload",
+    "load_baseline",
+    "render_json",
+    "render_text",
+    "run",
+    "write_baseline",
+]
+
+# Registration order == report order; rule name -> pass class.
+ALL_PASSES = (
+    TracePurityPass,
+    LockDisciplinePass,
+    CollectiveOrderPass,
+    DonationSafetyPass,
+    MarkerConventionPass,
+)
+
+
+def _default_context() -> AnalysisContext:
+    package_root = Path(__file__).resolve().parent.parent
+    return AnalysisContext(package_root=package_root, repo_root=package_root.parent)
+
+
+def run(
+    package_root: Optional[Path] = None,
+    rules: Optional[Sequence[str]] = None,
+    baseline: Optional[Path] = None,
+    tests_dir: Optional[Path] = None,
+) -> AnalysisResult:
+    """Run the selected passes (default: all) over ``package_root``."""
+    if package_root is None:
+        ctx = _default_context()
+    else:
+        package_root = Path(package_root).resolve()
+        ctx = AnalysisContext(
+            package_root=package_root, repo_root=package_root.parent
+        )
+    if tests_dir is not None:
+        ctx.tests_dir = Path(tests_dir)
+    passes = [cls() for cls in ALL_PASSES]
+    if rules is not None:
+        wanted = set(rules)
+        known = {p.rule for p in passes}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+        passes = [p for p in passes if p.rule in wanted]
+    baseline_keys = load_baseline(baseline) if baseline else None
+    return run_passes(passes, ctx, baseline_keys=baseline_keys)

@@ -1,0 +1,236 @@
+"""collective-order: extract per-family collective sequences, flag
+host-divergent branching around collectives.
+
+Multi-host SPMD correctness rests on one invariant: every process issues
+the SAME sequence of collectives over the SAME axes.  XLA guarantees this
+within one compiled program, so the residual risk is all at trace time —
+a Python-level branch whose predicate differs across hosts
+(``jax.process_index()``, ``os.environ``, wall clock, host RNG) traces a
+DIFFERENT program on different hosts, and the first mismatched ``psum``
+deadlocks the mesh with no diagnostic (the arXiv 2004.13336 failure mode:
+sharded weight-update paths where one rank skips a collective).
+
+Two jobs:
+
+  1. **Extraction** — :func:`extract_collective_sequences` walks each
+     step-family module (modules declaring ``PDT_COLLECTIVE_FAMILY``) and
+     records, per top-level builder, the ordered sequence of
+     ``psum``/``pmean``/``ppermute``/``all_gather``/``all_to_all``/...
+     calls with their axis expressions.  This is the mechanical oracle
+     for the ROADMAP item-3 step-family unification: the unified builder
+     must reproduce these sequences (pinned in PERF.md and
+     tests/test_static_analysis.py).
+  2. **Divergence detection** — a finding for any collective call under a
+     conditional (or loop) whose predicate reads host-identity or other
+     host-divergent state.  That is the statically decidable core of
+     "divergent orderings that would deadlock": config-driven branches
+     (``if sync_bn:``) are host-uniform by construction and not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    SourceModule,
+    dotted_name,
+)
+
+__all__ = [
+    "CollectiveOrderPass",
+    "CollectiveCall",
+    "extract_collective_sequences",
+    "COLLECTIVE_OPS",
+]
+
+COLLECTIVE_OPS = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "ppermute",
+    "pshuffle",
+    "all_gather",
+    "all_to_all",
+    "psum_scatter",
+}
+
+# Dotted fragments that mark a predicate as host-divergent: two hosts can
+# evaluate it differently at trace time, so a collective under it traces a
+# divergent program.
+_HOST_DIVERGENT_MARKERS = (
+    "process_index",
+    "process_count",
+    "host_id",
+    "local_device_count",  # differs on heterogeneous hosts
+    "os.environ",
+    "getenv",
+    "gethostname",
+    "getpid",
+    "time.",
+    "random.",
+    "np.random",
+    "numpy.random",
+    "urandom",
+)
+
+
+class CollectiveCall(NamedTuple):
+    op: str
+    axis: str  # source expression of the axis argument ("?" if absent)
+    function: str  # enclosing def name chain, e.g. "build_train_step.body"
+    line: int
+
+
+def _axis_expr(node: ast.Call) -> str:
+    """The axis operand: 2nd positional arg or the axis_name/axis_index kw.
+
+    ``ppermute(x, axis_name, perm)`` and ``psum(x, axis_name)`` both carry
+    the axis as the second positional; keep the raw source expression so
+    symbolic names (DATA_AXIS, axes) stay readable in the oracle.
+    """
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return ast.unparse(kw.value)
+    if len(node.args) >= 2:
+        return ast.unparse(node.args[1])
+    return "?"
+
+
+def _collective_op(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    if last not in COLLECTIVE_OPS:
+        return None
+    # require a lax-ish spelling (jax.lax.psum / lax.psum / bare from-import)
+    # so methods like obj.all_gather() on unrelated classes don't register
+    head = name.rsplit(".", 1)[0] if "." in name else ""
+    if head and head.split(".")[-1] not in ("lax", "jax"):
+        return None
+    return last
+
+
+def _family_of(module: SourceModule) -> Optional[str]:
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "PDT_COLLECTIVE_FAMILY":
+                    if isinstance(node.value, ast.Constant) and isinstance(
+                        node.value.value, str
+                    ):
+                        return node.value.value
+    return None
+
+
+def _extract_from_def(func: ast.AST, trail: str) -> List[CollectiveCall]:
+    out: List[CollectiveCall] = []
+
+    def visit(node: ast.AST, where: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, f"{where}.{child.name}")
+                continue
+            if isinstance(child, ast.Call):
+                op = _collective_op(child)
+                if op:
+                    out.append(
+                        CollectiveCall(op, _axis_expr(child), where, child.lineno)
+                    )
+            visit(child, where)
+    visit(func, trail)
+    out.sort(key=lambda c: c.line)
+    return out
+
+
+def extract_collective_sequences(
+    package_root, repo_root=None
+) -> Dict[str, Dict[str, List[CollectiveCall]]]:
+    """{family: {builder_name: [CollectiveCall, ...]}} for every module
+    declaring ``PDT_COLLECTIVE_FAMILY``.  Order is source order, which for
+    these step files equals trace order (straight-line builders)."""
+    from pathlib import Path
+
+    from .core import collect_modules
+
+    package_root = Path(package_root)
+    repo_root = Path(repo_root) if repo_root is not None else package_root.parent
+    out: Dict[str, Dict[str, List[CollectiveCall]]] = {}
+    for module in collect_modules(package_root, repo_root):
+        family = _family_of(module)
+        if family is None:
+            continue
+        builders: Dict[str, List[CollectiveCall]] = {}
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                calls = _extract_from_def(node, node.name)
+                if calls:
+                    builders[node.name] = calls
+        out[family] = builders
+    return out
+
+
+class CollectiveOrderPass(AnalysisPass):
+    rule = "collective-order"
+    description = (
+        "collectives must not sit under host-divergent trace-time branches "
+        "(process_index/env/clock/host-RNG predicates)"
+    )
+
+    def run(self, modules: Sequence[SourceModule], ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in modules:
+            findings.extend(self._check_module(module))
+        return findings
+
+    def _check_module(self, module: SourceModule) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            test = None
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            if test is None:
+                continue
+            marker = self._divergent_marker(test)
+            if marker is None:
+                continue
+            if isinstance(node, ast.IfExp):
+                bodies = [node.body, node.orelse]
+            else:
+                bodies = list(node.body) + list(node.orelse)
+            for body_node in bodies:
+                for sub in ast.walk(body_node):
+                    if isinstance(sub, ast.Call):
+                        op = _collective_op(sub)
+                        if op:
+                            findings.append(
+                                Finding(
+                                    rule=self.rule,
+                                    severity=SEVERITY_ERROR,
+                                    path=module.rel,
+                                    line=sub.lineno,
+                                    # no line numbers in the message:
+                                    # baseline keys must survive code motion
+                                    message=(
+                                        f"`{op}` under a branch on `{marker}`"
+                                        ": hosts can trace different "
+                                        "collective sequences and deadlock "
+                                        "the mesh"
+                                    ),
+                                )
+                            )
+        return findings
+
+    def _divergent_marker(self, test: ast.AST) -> Optional[str]:
+        src = ast.unparse(test)
+        for marker in _HOST_DIVERGENT_MARKERS:
+            if marker in src:
+                return marker.rstrip(".")
+        return None

@@ -1,0 +1,220 @@
+"""lock-discipline: declared-shared attributes only move under their lock.
+
+The convention (documented in RULES.md):
+
+  - An attribute assignment carrying a trailing comment
+    ``# guarded by: self._lock`` declares that attribute SHARED between
+    threads and guarded by that lock/condition expression.  The natural
+    place is the ``__init__`` that creates it.
+  - Every read or write of a declared attribute in any OTHER method of
+    the class must sit inside a ``with <guard>:`` block — or the method
+    itself must be declared lock-held context, either by the naming
+    convention ``*_locked`` or by carrying the same ``# guarded by:``
+    comment on its ``def`` line (for helpers whose contract is "caller
+    holds the lock").
+  - ``__init__`` is exempt: object construction happens-before any
+    thread that could observe the attribute (thread starts and object
+    publication provide the barrier).
+
+This is lockset analysis at its cheapest: no aliasing, no inter-
+procedural reasoning — but it is exactly the discipline the codebase's
+five host-side thread types (async checkpoint writer, heartbeat,
+watchdog, worker pool, continuous-batching scheduler) already follow by
+hand, and making it mechanical means a refactor that hoists a read out
+of a ``with`` block fails analysis instead of corrupting a chaos run
+once a month.  Benign races (single-writer counters read for telemetry)
+are suppressed inline with a justification, which doubles as the
+documentation that the race was SEEN and judged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    SourceModule,
+    dotted_name,
+)
+
+__all__ = ["LockDisciplinePass", "GUARDED_BY_RE"]
+
+GUARDED_BY_RE = re.compile(r"#\s*guarded by:\s*(self\.[A-Za-z_]\w*)")
+
+
+class _ClassAudit:
+    """Guarded-attribute declarations + lock-held methods for one class."""
+
+    def __init__(self, module: SourceModule, cls: ast.ClassDef):
+        self.module = module
+        self.cls = cls
+        # attr name -> (guard expr, declaring line)
+        self.guarded: Dict[str, Tuple[str, int]] = {}
+        # method node -> set of guards assumed held on entry
+        self.held_on_entry: Dict[ast.AST, Set[str]] = {}
+        self.methods: List[ast.AST] = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self._collect_declarations()
+        self._collect_locked_methods()
+
+    def _line_guard(self, lineno: int) -> Optional[str]:
+        if 1 <= lineno <= len(self.module.lines):
+            m = GUARDED_BY_RE.search(self.module.lines[lineno - 1])
+            if m:
+                return m.group(1)
+        return None
+
+    def _collect_declarations(self) -> None:
+        for method in self.methods:
+            for node in ast.walk(method):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        guard = self._line_guard(t.lineno)
+                        if guard:
+                            self.guarded.setdefault(t.attr, (guard, t.lineno))
+
+    def _collect_locked_methods(self) -> None:
+        all_guards = {g for g, _ in self.guarded.values()}
+        for method in self.methods:
+            held: Set[str] = set()
+            guard = self._line_guard(method.lineno)
+            if guard:
+                held.add(guard)
+            if method.name.endswith("_locked"):
+                # naming convention: caller holds the class's guard(s);
+                # with several distinct guards, prefer the explicit comment
+                held.update(all_guards)
+            self.held_on_entry[method] = held
+
+
+class _MethodChecker(ast.NodeVisitor):
+    """Track `with <guard>:` nesting and flag naked guarded accesses."""
+
+    def __init__(self, audit: _ClassAudit, method: ast.AST, rule: str):
+        self.audit = audit
+        self.method = method
+        self.rule = rule
+        self.held: Set[str] = set(audit.held_on_entry.get(method, ()))
+        self.findings: List[Finding] = []
+
+    # -- lock acquisition ------------------------------------------------ #
+
+    def visit_With(self, node: ast.With) -> None:
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        self._visit_with(node)
+
+    def _visit_with(self, node) -> None:
+        acquired = []
+        for item in node.items:
+            expr = dotted_name(item.context_expr)
+            if expr and expr not in self.held:
+                acquired.append(expr)
+        self.held.update(acquired)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held.difference_update(acquired)
+        # context expressions themselves are evaluated unlocked
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    # -- scope boundaries ------------------------------------------------ #
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_nested(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_nested(node)
+
+    def _visit_nested(self, node) -> None:
+        # A nested def runs at CALL time, not where it is defined: the
+        # enclosing with-block's lock is not held when it eventually runs
+        # (thread targets are the canonical case).  Check it with an empty
+        # lockset unless its own def line declares otherwise.
+        saved = self.held
+        self.held = set()
+        guard = self.audit._line_guard(node.lineno)
+        if guard:
+            self.held.add(guard)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved = self.held
+        self.held = set()
+        self.visit(node.body)
+        self.held = saved
+
+    # -- the accesses ---------------------------------------------------- #
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            info = self.audit.guarded.get(node.attr)
+            if info is not None:
+                guard, decl_line = info
+                if guard not in self.held:
+                    verb = "written" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+                    self.findings.append(
+                        Finding(
+                            rule=self.rule,
+                            severity=SEVERITY_ERROR,
+                            path=self.audit.module.rel,
+                            line=node.lineno,
+                            # no declaration line number in the message:
+                            # baseline keys must survive code motion
+                            message=(
+                                f"self.{node.attr} {verb} without holding {guard} in "
+                                f"{self.audit.cls.name}.{self.method.name} "
+                                "(attribute declared shared)"
+                            ),
+                        )
+                    )
+        self.generic_visit(node)
+
+
+class LockDisciplinePass(AnalysisPass):
+    rule = "lock-discipline"
+    description = (
+        "attributes declared '# guarded by: self._lock' must only be "
+        "accessed under that lock outside __init__"
+    )
+
+    def run(self, modules: Sequence[SourceModule], ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(module, node))
+        return findings
+
+    def _check_class(self, module: SourceModule, cls: ast.ClassDef) -> List[Finding]:
+        audit = _ClassAudit(module, cls)
+        if not audit.guarded:
+            return []
+        findings: List[Finding] = []
+        for method in audit.methods:
+            if method.name == "__init__":
+                continue  # construction happens-before publication
+            checker = _MethodChecker(audit, method, self.rule)
+            for stmt in method.body:
+                checker.visit(stmt)
+            findings.extend(checker.findings)
+        return findings

@@ -1,0 +1,316 @@
+"""trace-purity: traced functions must be referentially transparent.
+
+A function that reaches ``jax.jit`` / ``pjit`` / ``jax.shard_map`` /
+``lax.while_loop`` / ``lax.scan`` / ``lax.cond`` is executed ONCE at trace
+time and never again: a ``time.time()`` inside it bakes the compile-time
+clock into the program, ``np.random`` draws a constant, ``os.environ``
+reads silently fork the traced program across hosts (a cross-host deadlock
+when a collective sits downstream), and ``print`` fires once per
+(re)trace — the classic "why did my log stop" confusion that actually
+signals a retrace storm.  Host-side impurity belongs OUTSIDE the traced
+closure; inside it, use ``jax.random`` for randomness and
+``jax.debug.print`` / ``io_callback`` for effects.
+
+Mechanics: per module, trace roots are (a) defs decorated with
+``jax.jit``/``jit``/``pjit`` (directly or through ``functools.partial``)
+and (b) local defs passed by name into a jit-family call
+(``jit``/``pjit``/``shard_map``/``while_loop``/``scan``/``cond``/
+``fori_loop``/``checkpoint``/``remat``/``custom_vjp``...).  From the
+roots, any *name reference* resolving to another def in an enclosing
+scope joins the traced closure (this catches ``value_and_grad(loss_fn)``
+and scan bodies without modeling higher-order flow).  Calls crossing
+module boundaries are not followed — each module is analyzed against its
+own closure, which keeps the pass O(tree) and the findings local.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    SourceModule,
+    dotted_name,
+    iter_child_statements,
+)
+
+__all__ = ["TracePurityPass", "TRACE_ENTRY_POINTS"]
+
+# Call names (last attribute segment) whose function-valued arguments are
+# traced.  ``jit`` et al. trace their first argument; control-flow
+# primitives trace every callable operand — we conservatively treat every
+# Name argument that resolves to a local def as entering the trace.
+TRACE_ENTRY_POINTS = {
+    "jit",
+    "pjit",
+    "shard_map",
+    "while_loop",
+    "scan",
+    "cond",
+    "switch",
+    "fori_loop",
+    "associative_scan",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+    "grad",
+    "value_and_grad",
+    "vmap",
+    "pmap",
+    "eval_shape",
+}
+
+# Dotted-prefix ban list.  An entry ending in '.' bans the whole module
+# namespace; an exact entry bans that one callable/attribute.
+_BANNED_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ("time.", "wall-clock read baked in at trace time"),
+    ("random.", "host RNG draws a trace-time constant; use jax.random"),
+    ("np.random.", "host RNG draws a trace-time constant; use jax.random"),
+    ("numpy.random.", "host RNG draws a trace-time constant; use jax.random"),
+    ("os.environ", "env read can differ across hosts and fork the traced program"),
+    ("os.getenv", "env read can differ across hosts and fork the traced program"),
+    ("os.urandom", "host RNG draws a trace-time constant; use jax.random"),
+    ("uuid.uuid4", "host RNG draws a trace-time constant"),
+    ("datetime.now", "wall-clock read baked in at trace time"),
+    ("datetime.datetime.now", "wall-clock read baked in at trace time"),
+)
+
+_BANNED_BARE_CALLS = {
+    "print": "fires once per (re)trace, not per step; use jax.debug.print",
+    "open": "file I/O inside a traced function runs at trace time only",
+    "input": "blocking host I/O inside a traced function",
+}
+
+
+def _last_segment(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+class _FunctionIndex:
+    """All defs in a module + the scope chain needed to resolve names."""
+
+    def __init__(self, module: SourceModule):
+        self.module = module
+        # def node -> (enclosing def nodes, outermost first)
+        self.parents: Dict[ast.AST, Tuple[ast.AST, ...]] = {}
+        # def node -> {local def name -> def node} for its immediate children
+        self.children: Dict[ast.AST, Dict[str, ast.AST]] = {}
+        self.module_defs: Dict[str, ast.AST] = {}
+        self.qualnames: Dict[ast.AST, str] = {}
+        self._index(module.tree, (), ())
+
+    def _index(self, node: ast.AST, chain: Tuple[ast.AST, ...], names: Tuple[str, ...]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.parents[child] = chain
+                self.qualnames[child] = ".".join(names + (child.name,))
+                if chain:
+                    self.children.setdefault(chain[-1], {})[child.name] = child
+                else:
+                    self.module_defs[child.name] = child
+                self.children.setdefault(child, {})
+                self._index(child, chain + (child,), names + (child.name,))
+            elif isinstance(child, ast.ClassDef):
+                # methods resolve like module-level defs scoped by class name;
+                # they do not close over each other by bare name, so no chain
+                self._index(child, chain, names + (child.name,))
+            else:
+                self._index(child, chain, names)
+
+    def resolve(self, name: str, scope: ast.AST) -> Optional[ast.AST]:
+        """Resolve a bare name reference from inside ``scope`` to a def."""
+        local = self.children.get(scope, {})
+        if name in local:
+            return local[name]
+        for parent in reversed(self.parents.get(scope, ())):
+            sibling = self.children.get(parent, {})
+            if name in sibling:
+                return sibling[name]
+        return self.module_defs.get(name)
+
+
+class TracePurityPass(AnalysisPass):
+    rule = "trace-purity"
+    description = (
+        "functions reaching jit/pjit/shard_map/while_loop/scan must not "
+        "perform host I/O, host RNG, clock/env reads, or global mutation"
+    )
+
+    def run(self, modules: Sequence[SourceModule], ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in modules:
+            findings.extend(self._run_module(module))
+        return findings
+
+    # ------------------------------------------------------------------ #
+
+    def _run_module(self, module: SourceModule) -> List[Finding]:
+        index = _FunctionIndex(module)
+        roots = self._trace_roots(module, index)
+        if not roots:
+            return []
+        closure = self._closure(roots, index)
+        findings: List[Finding] = []
+        for func, root in closure.items():
+            findings.extend(self._check_function(module, index, func, root))
+        return findings
+
+    def _trace_roots(self, module: SourceModule, index: _FunctionIndex) -> Dict[ast.AST, ast.AST]:
+        roots: Dict[ast.AST, ast.AST] = {}
+        # (a) jit/pjit-decorated defs
+        for func in index.qualnames:
+            for deco in getattr(func, "decorator_list", []):
+                if self._is_jit_expr(deco):
+                    roots[func] = func
+        # (b) local defs passed by name into a trace entry point
+        for scope in list(index.qualnames) + [module.tree]:
+            body_iter = (
+                iter_child_statements(scope)
+                if scope is not module.tree
+                else self._module_level_nodes(module.tree)
+            )
+            for node in body_iter:
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _last_segment(dotted_name(node.func))
+                if callee not in TRACE_ENTRY_POINTS:
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        target = index.resolve(
+                            arg.id, scope if scope is not module.tree else module.tree
+                        )
+                        if target is None and scope is module.tree:
+                            target = index.module_defs.get(arg.id)
+                        if target is not None:
+                            roots.setdefault(target, target)
+        return roots
+
+    def _module_level_nodes(self, tree: ast.Module):
+        stack = [n for n in tree.body]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        """`@jax.jit`, `@jit`, `@pjit`, `@jax.jit(...)`, or
+        `@functools.partial(jax.jit, ...)`."""
+        name = dotted_name(node)
+        if name and _last_segment(name) in ("jit", "pjit"):
+            return True
+        if isinstance(node, ast.Call):
+            fn = dotted_name(node.func)
+            if fn and _last_segment(fn) in ("jit", "pjit"):
+                return True
+            if fn and _last_segment(fn) == "partial":
+                return any(
+                    _last_segment(dotted_name(a)) in ("jit", "pjit") for a in node.args
+                )
+        return False
+
+    def _closure(
+        self, roots: Dict[ast.AST, ast.AST], index: _FunctionIndex
+    ) -> Dict[ast.AST, ast.AST]:
+        """Transitive set of defs reachable by NAME from the roots."""
+        seen: Dict[ast.AST, ast.AST] = {}
+        stack = [(f, f) for f in roots]
+        while stack:
+            func, root = stack.pop()
+            if func in seen:
+                continue
+            seen[func] = root
+            for node in iter_child_statements(func):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    target = index.resolve(node.id, func)
+                    if target is not None and target not in seen:
+                        stack.append((target, root))
+        return seen
+
+    def _check_function(
+        self,
+        module: SourceModule,
+        index: _FunctionIndex,
+        func: ast.AST,
+        root: ast.AST,
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        qual = index.qualnames.get(func, getattr(func, "name", "<anon>"))
+        root_qual = index.qualnames.get(root, getattr(root, "name", "<anon>"))
+        where = (
+            f"traced function `{qual}`"
+            if func is root
+            else f"`{qual}` (traced via `{root_qual}`)"
+        )
+        reported_prefixes: Set[Tuple[int, str]] = set()
+        for node in iter_child_statements(func):
+            if isinstance(node, ast.Global):
+                findings.append(
+                    Finding(
+                        rule=self.rule,
+                        severity=SEVERITY_ERROR,
+                        path=module.rel,
+                        line=node.lineno,
+                        message=(
+                            f"{where} declares `global {', '.join(node.names)}`:"
+                            " module-global mutation under trace runs once at"
+                            " trace time and never per step"
+                        ),
+                    )
+                )
+            if isinstance(node, ast.Call):
+                bare = node.func.id if isinstance(node.func, ast.Name) else None
+                if bare in _BANNED_BARE_CALLS:
+                    findings.append(
+                        Finding(
+                            rule=self.rule,
+                            severity=SEVERITY_ERROR,
+                            path=module.rel,
+                            line=node.lineno,
+                            message=f"{where} calls `{bare}(...)`: {_BANNED_BARE_CALLS[bare]}",
+                        )
+                    )
+                    continue
+            name = dotted_name(node) if isinstance(node, (ast.Attribute, ast.Call)) else None
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+            if not name:
+                continue
+            for prefix, why in _BANNED_PREFIXES:
+                if prefix.endswith("."):
+                    hit = name.startswith(prefix)
+                else:
+                    hit = name == prefix or name.startswith(prefix + ".")
+                if hit:
+                    # a Call and the Attribute nested inside it both match
+                    # the same prefix; report once (the Call comes first and
+                    # carries the fuller dotted name)
+                    pkey = (node.lineno, prefix)
+                    if pkey in reported_prefixes:
+                        break
+                    reported_prefixes.add(pkey)
+                    findings.append(
+                        Finding(
+                            rule=self.rule,
+                            severity=SEVERITY_ERROR,
+                            path=module.rel,
+                            line=node.lineno,
+                            message=f"{where} uses `{name}`: {why}",
+                        )
+                    )
+                    break
+        # de-dup: an Attribute nested in a Call reports twice otherwise
+        unique = []
+        seen_keys = set()
+        for f in findings:
+            k = (f.line, f.message)
+            if k not in seen_keys:
+                seen_keys.add(k)
+                unique.append(f)
+        return unique

@@ -54,6 +54,10 @@ from .steps import TrainState
 
 __all__ = ["build_pp_lm_train_step", "build_pp_lm_eval_step"]
 
+# Step-family label for the static collective-order oracle (see
+# analysis/collectives.py and PERF.md).
+PDT_COLLECTIVE_FAMILY = "pp"
+
 
 def _stage_applies(model, seq_axis=None):
     """(embed, blocks, head) closures over a TransformerLM's hyperparams.

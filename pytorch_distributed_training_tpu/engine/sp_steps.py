@@ -39,6 +39,10 @@ from .steps import TrainState
 
 __all__ = ["build_lm_train_step", "build_lm_eval_step", "lm_loss_local"]
 
+# Step-family label for the static collective-order oracle (see
+# analysis/collectives.py and PERF.md).
+PDT_COLLECTIVE_FAMILY = "sp"
+
 
 def lm_loss_local(logits, labels, global_tokens: int, label_smoothing: float = 0.0):
     """Local partial loss: sum of per-token CE / global token count (fp32).

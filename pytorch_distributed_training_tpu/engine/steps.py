@@ -41,6 +41,11 @@ __all__ = [
     "init_train_state",
 ]
 
+# Step-family label for the static collective-order oracle (see
+# analysis/collectives.py and PERF.md): all collectives emitted by the
+# builders in this module belong to the data-parallel family.
+PDT_COLLECTIVE_FAMILY = "dp"
+
 
 class TrainState(struct.PyTreeNode):
     """Replicated training state: params + BN running stats + optimizer state.

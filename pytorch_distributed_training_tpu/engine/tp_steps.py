@@ -42,6 +42,12 @@ def _token_spec(mesh: Mesh) -> P:
 
 __all__ = ["build_tp_lm_train_step", "build_tp_lm_eval_step"]
 
+# Step-family label for the static collective-order oracle (see
+# analysis/collectives.py and PERF.md).  The TP path is GSPMD-compiled:
+# collectives are inserted by the partitioner, so the static extraction
+# legitimately reports zero explicit collectives for this family.
+PDT_COLLECTIVE_FAMILY = "tp"
+
 
 def build_tp_lm_train_step(
     model,

@@ -57,14 +57,14 @@ class StepWatchdog:
         self.factor = float(factor)
         self.min_seconds = float(min_seconds)
         self.warmup = int(warmup)
-        self.fires = 0
-        self._times: deque = deque(maxlen=int(window))
+        self.fires = 0  # guarded by: self._lock
+        self._times: deque = deque(maxlen=int(window))  # guarded by: self._lock
         self._on_hang = on_hang
         self._logger = logger
         self._lock = threading.Lock()
-        self._cur_step: Optional[int] = None
-        self._cur_start: float = 0.0
-        self._fired_for: Optional[int] = None
+        self._cur_step: Optional[int] = None  # guarded by: self._lock
+        self._cur_start: float = 0.0  # guarded by: self._lock
+        self._fired_for: Optional[int] = None  # guarded by: self._lock
         self._stop = threading.Event()
         self._poll = (
             float(poll_seconds)
@@ -96,7 +96,7 @@ class StepWatchdog:
             return statistics.median(self._times) if self._times else None
 
     # --------------------------------------------------------------- monitor
-    def _limit(self) -> Optional[float]:
+    def _limit(self) -> Optional[float]:  # guarded by: self._lock
         """Current hang threshold; None while unarmed (warming up)."""
         if len(self._times) < self.warmup:
             return None
@@ -120,7 +120,7 @@ class StepWatchdog:
                 if self._cur_step != step or step == self._fired_for:
                     continue
                 self._fired_for = step
-            self.fires += 1
+                self.fires += 1
             if self._logger is not None:
                 self._logger.error(
                     "watchdog: step %d running for %.2fs (limit %.2fs)",

@@ -94,12 +94,12 @@ class DynamicBatcher:
         self.max_delay = max_delay_ms / 1000.0
         self.deadline_ms = deadline_ms
         self.max_backlog = max_backlog
-        self.timeouts = 0
-        self.sheds = 0
+        self.timeouts = 0  # guarded by: self._cond
+        self.sheds = 0  # guarded by: self._cond
         self._on_timeout = on_timeout
         self._on_shed = on_shed
-        self._queue: "deque[Request]" = deque()
-        self._closed = False
+        self._queue: "deque[Request]" = deque()  # guarded by: self._cond
+        self._closed = False  # guarded by: self._cond
         self._cond = threading.Condition()
         self._thread = threading.Thread(
             target=self._loop, name="serving-batcher", daemon=True
@@ -170,7 +170,7 @@ class DynamicBatcher:
 
     # ------------------------------------------------------------------ #
 
-    def _expired(self, req: Request) -> bool:
+    def _expired(self, req: Request) -> bool:  # guarded by: self._cond
         """Resolve an over-deadline request with ``TimeoutError``; True if
         it expired (the caller must not batch it)."""
         if req.deadline is None or time.monotonic() < req.deadline:

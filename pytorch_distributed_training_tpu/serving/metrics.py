@@ -46,19 +46,19 @@ class ServingMetrics:
         # block-pool utilization, both recorded as fractions in [0, 1]
         self._slot_occ = self._registry.histogram("slot_occupancy", _RESERVOIR)
         self._block_util = self._registry.histogram("block_util", _RESERVOIR)
-        self._items = 0
-        self._first_t: Optional[float] = None
-        self._last_t: Optional[float] = None
-        self._max_depth = 0
+        self._items = 0  # guarded by: self._lock
+        self._first_t: Optional[float] = None  # guarded by: self._lock
+        self._last_t: Optional[float] = None  # guarded by: self._lock
+        self._max_depth = 0  # guarded by: self._lock
         # LM phase split (round 6): accumulated prefill/decode device
         # seconds and the tokens each phase is RESPONSIBLE for.  Generated
         # token 0 is sampled by the prefill program, so it counts as a
         # prefill token (the attribution fix of PR 7 — it was previously
         # lumped into decode throughput and documented-not-corrected).
-        self._prefill_tokens = 0
-        self._decode_tokens = 0
-        self._prefill_s = 0.0
-        self._decode_s = 0.0
+        self._prefill_tokens = 0  # guarded by: self._lock
+        self._decode_tokens = 0  # guarded by: self._lock
+        self._prefill_s = 0.0  # guarded by: self._lock
+        self._decode_s = 0.0  # guarded by: self._lock
 
     def incr(self, name: str, n: int = 1) -> None:
         """Bump a named degradation counter (e.g. ``timeouts``, ``sheds``)."""

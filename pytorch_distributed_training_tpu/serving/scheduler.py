@@ -169,12 +169,16 @@ class ContinuousScheduler:
             self._pool = jax.device_put(self._pool, pool_sharding)
         self._pad_key = jax.random.PRNGKey(0)
         self._base_rng = jax.random.PRNGKey(int(seed))
-        self._seq_no = 0
+        self._seq_no = 0  # guarded by: self._cond
 
+        # _slots is the scheduler thread's working set: only _admit /
+        # _fail_inflight / drain touch it cross-thread, and they take the
+        # condition; per-iteration reads/writes in the loop body stay
+        # lock-free by thread confinement (see module docstring).
         self._slots: List[Optional[_PagedRequest]] = [None] * self.slots_n
-        self._queue: "deque[_PagedRequest]" = deque()
+        self._queue: "deque[_PagedRequest]" = deque()  # guarded by: self._cond
         self._cond = threading.Condition()
-        self._closed = False
+        self._closed = False  # guarded by: self._cond
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -255,8 +259,14 @@ class ContinuousScheduler:
             return len(self._queue)
 
     def active(self) -> int:
-        """Slots currently decoding."""
-        return sum(1 for s in self._slots if s is not None)
+        """Slots currently decoding.
+
+        Takes the condition: callers poll this from foreign threads, and
+        an unlocked read races _fail_inflight's wholesale rebind of the
+        slot list (it could observe retired requests as still active).
+        """
+        with self._cond:
+            return sum(1 for s in self._slots if s is not None)
 
     def compile_count(self) -> int:
         """Distinct XLA programs compiled so far: bounded by the prefill

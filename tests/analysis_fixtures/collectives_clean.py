@@ -1,0 +1,27 @@
+"""Clean counterpart for collective-order: collectives under uniform
+(config-driven) predicates are fine — every host traces the same
+program because every host sees the same config."""
+import jax
+import jax.numpy as jnp
+
+PDT_COLLECTIVE_FAMILY = "fixture-good"
+
+
+def build_uniform_step(sync_stats: bool):
+    def body(x):
+        # config flags are host-uniform: all hosts take the same branch
+        if sync_stats:
+            x = jax.lax.pmean(x, "data")
+        loss = jnp.sum(x)
+        return jax.lax.psum(loss, "data")
+
+    return body
+
+
+def build_plain_step():
+    def body(grads, loss):
+        grads = jax.lax.psum(grads, "data")
+        loss = jax.lax.pmean(loss, "data")
+        return grads, loss
+
+    return body

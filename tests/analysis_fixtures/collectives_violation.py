@@ -1,0 +1,35 @@
+"""Seeded collective-order violations: collectives under host-divergent
+predicates — different hosts trace different programs and the mesh
+deadlocks at the first mismatched collective."""
+import os
+
+import jax
+import jax.numpy as jnp
+
+PDT_COLLECTIVE_FAMILY = "fixture-bad"
+
+
+def build_divergent_step():
+    def body(x):
+        # VIOLATION: branch on process identity around a collective
+        if jax.process_index() == 0:
+            x = jax.lax.psum(x, "data")
+        return jax.lax.pmean(x, "data")
+
+    return body
+
+
+def build_env_divergent_step():
+    def body(x):
+        # VIOLATION: env reads can differ across hosts at trace time
+        if os.environ.get("PDT_EXTRA_REDUCE"):
+            x = jax.lax.all_gather(x, "data")
+        total = jax.lax.psum(x, "data")
+        return total
+
+    return body
+
+
+def build_ternary_divergent(x):
+    # VIOLATION: same trap spelled as a conditional expression
+    return jax.lax.psum(x, "data") if jax.process_count() > 1 else x

@@ -1,0 +1,14 @@
+"""Marker-convention (counter-store) fixture: a private counter ledger
+outside telemetry/ — invisible to the goodput snapshot."""
+from collections import Counter
+
+
+class ShadowLedger:
+    def __init__(self):
+        self._counters = {}
+
+    def bump(self, name):
+        self._counters[name] = self._counters.get(name, 0) + 1
+
+
+_module_counters = Counter()

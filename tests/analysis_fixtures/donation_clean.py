@@ -1,0 +1,23 @@
+"""Clean counterpart for donation-safety: the consume-and-rebind idiom —
+the donated name is re-stored by the very statement that donates it."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch, 0.0
+
+
+def run_epoch(state, batches):
+    for batch in batches:
+        state, loss = train_step(state, batch)
+    return state, loss
+
+
+def run_with_copy(state, batch):
+    # keeping the pre-step state is fine if you copy BEFORE donating
+    before = state.copy()
+    state, loss = train_step(state, batch)
+    return state - before, loss

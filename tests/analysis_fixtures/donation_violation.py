@@ -1,0 +1,36 @@
+"""Seeded donation-safety violations: donated buffers touched after the
+call, and donate_argnums out of range of the signature."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch, 0.0
+
+
+@functools.partial(jax.jit, donate_argnums=(3,))
+def bad_arity_step(state, batch):
+    # VIOLATION (arity): argnum 3 with only 2 positional params
+    return state + batch
+
+
+def run_epoch(state, batches):
+    for batch in batches:
+        new_state, loss = train_step(state, batch)
+        # VIOLATION: `state` is dead after donation; this reads the
+        # donated buffer (and never rebinds it, so every iteration
+        # donates the same dead array again)
+        drift = new_state - state
+        del drift
+    return new_state
+
+
+apply_update = jax.jit(lambda s, g: s - g, donate_argnums=(0,))
+
+
+def double_apply(state, grads):
+    out = apply_update(state, grads)
+    # VIOLATION: second use of the donated `state`
+    return out, apply_update(state, grads)

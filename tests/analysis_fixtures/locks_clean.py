@@ -1,0 +1,38 @@
+"""Clean counterpart for lock-discipline: every guarded access under the
+declared condition, plus both lock-held-helper spellings."""
+import threading
+
+
+class TidyQueue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []  # guarded by: self._cond
+        self._closed = False  # guarded by: self._cond
+
+    def put(self, item):
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("closed")
+            self._items.append(item)
+            self._cond.notify_all()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _drain_locked(self):
+        out = list(self._items)
+        self._items.clear()
+        return out
+
+    def _peek(self):  # guarded by: self._cond
+        return self._items[0] if self._items else None
+
+    def take_all(self):
+        with self._cond:
+            while not self._items and not self._closed:
+                self._cond.wait()
+            first = self._peek()
+            del first
+            return self._drain_locked()

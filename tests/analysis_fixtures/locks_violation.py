@@ -1,0 +1,37 @@
+"""Seeded lock-discipline violations: guarded attrs touched without the
+declared lock, including the hoisted-out-of-with refactor bug and the
+nested thread-target trap."""
+import threading
+
+
+class LeakyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded by: self._lock
+        self._high_water = 0  # guarded by: self._lock
+
+    def bump(self):
+        self._count += 1  # VIOLATION: write without the lock
+
+    def read(self):
+        return self._count  # VIOLATION: read without the lock
+
+    def bump_locked(self):
+        self._count += 1  # ok: _locked suffix = caller holds the lock
+
+    def watermark(self):
+        with self._lock:
+            if self._count > self._high_water:
+                self._high_water = self._count  # ok: under the lock
+        return self._high_water  # VIOLATION: hoisted out of the with
+
+    def start_worker(self):
+        with self._lock:
+            def worker():
+                # VIOLATION: the nested def runs at call time on another
+                # thread; the enclosing with-block's lock is NOT held
+                self._count += 1
+
+            t = threading.Thread(target=worker)
+        t.start()
+        return t

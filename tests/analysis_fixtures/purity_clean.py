@@ -1,0 +1,29 @@
+"""Clean counterpart for trace-purity: the same shapes done right —
+host impurity outside the trace, jax.random / jax.debug.print inside."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_step(x, rng):
+    noise = jax.random.normal(rng, x.shape)
+    jax.debug.print("step max {m}", m=jnp.max(x))
+    return x + noise
+
+
+def timed_call(step, x, rng):
+    # clock reads belong on the host side, bracketing the traced call
+    t0 = time.perf_counter()
+    y = step(x, rng)
+    y.block_until_ready()
+    return y, time.perf_counter() - t0
+
+
+def scan_body_pure(carry, x):
+    return carry + x, x
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body_pure, 0.0, xs)

@@ -1,0 +1,50 @@
+"""Seeded trace-purity violations: every banned category in one traced
+closure.  Scanned by test_static_analysis.py, never imported."""
+import functools
+import os
+import random
+import time
+
+import jax
+import numpy as np
+
+_STEP_COUNT = 0
+
+
+@jax.jit
+def clock_in_trace(x):
+    return x + time.time()  # wall-clock read
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def host_rng_in_trace(x, n):
+    noise = np.random.normal(size=n)  # host RNG
+    return x + noise
+
+
+def env_helper(x):
+    # reached from the jitted root below through a plain name reference
+    return x * float(os.getenv("PDT_SCALE", "1"))
+
+
+def build_step():
+    def step(x):
+        print("tracing", x.shape)  # fires once per retrace
+        return env_helper(x)
+
+    return jax.jit(step)
+
+
+@jax.jit
+def global_mutation(x):
+    global _STEP_COUNT
+    _STEP_COUNT += 1
+    return x
+
+
+def scan_body_impure(carry, x):
+    return carry + random.random(), x  # host RNG in a scan body
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body_impure, 0.0, xs)

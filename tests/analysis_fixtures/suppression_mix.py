@@ -1,0 +1,22 @@
+"""Suppression-semantics fixture: three identical violations — one raw,
+one suppressed by a trailing comment, one by a comment-only line above.
+The pass must report exactly the first."""
+import time
+
+import jax
+
+
+@jax.jit
+def raw_violation(x):
+    return x + time.time()
+
+
+@jax.jit
+def trailing_suppressed(x):
+    return x + time.time()  # pdt: ignore[trace-purity] -- fixture: trailing form
+
+
+@jax.jit
+def line_above_suppressed(x):
+    # pdt: ignore[trace-purity] -- fixture: comment-line form
+    return x + time.time()

@@ -1,180 +1,97 @@
 """Static convention guards: test markers and the one-ledger rule.
 
-The driver's tier-1 gate runs ``pytest -m 'not slow'`` inside a 870s
-budget (ROADMAP.md).  Any test that shells out to ``bench.py`` pays a
-full model compile + timed windows in a subprocess — minutes, not
-seconds — so it must carry ``@pytest.mark.slow`` or it silently eats the
-tier-1 budget.  A static AST scan (collection-speed, no imports) rather
-than a runtime fixture: the convention must hold even for tests that
-would be skipped on this platform.
-
-The same file also pins the telemetry layer's structural invariant: all
-observability counters flow through ``telemetry/registry.py`` — a new
-ad-hoc counter store (``self._counters = {}``-style) anywhere else in the
-package is rejected at collection speed.
+The rules themselves now live in the analysis framework
+(``pytorch_distributed_training_tpu/analysis/conventions.py``, rule
+``marker-convention``) so they run identically from the CLI,
+``bench.py lint``, and this tier-1 gate.  This file is a thin wrapper
+kept under its historical name: each test invokes the pass and asserts
+its slice of the findings is empty, preserving the exact coverage the
+standalone guard had in PRs 2-7 (bench-driving tests are slow-marked,
+fault-machinery tests are slow/chaos-marked, no ad-hoc counter stores
+outside telemetry/) plus the scan-coverage pin on the serving modules.
 """
 import ast
 import pathlib
 
-
-# Anything that runs a bench — shelling out to bench.py OR calling a bench
-# entry point in-process (import bench / bench_ckpt() / bench_chaos() /
-# bench_serve(), which compile real models and run timed windows) — pays
-# compiles and timed windows and must not ride the default tier.
-_BENCH_DRIVERS = (
-    "bench.py", "import bench", "bench_ckpt(", "bench_chaos(", "bench_serve(",
+from pytorch_distributed_training_tpu import analysis
+from pytorch_distributed_training_tpu.analysis.conventions import (
+    MarkerConventionPass,
+    is_counter_store,
 )
+
+_REPO = pathlib.Path(__file__).parent.parent
+_PKG = _REPO / "pytorch_distributed_training_tpu"
+
+
+def _run_marker_pass():
+    return analysis.run(rules=["marker-convention"])
 
 
 def test_bench_driving_tests_are_slow_marked():
-    here = pathlib.Path(__file__).parent
-    offenders = []
-    for path in sorted(here.glob("test_*.py")):
-        if path.name == "test_marker_convention.py":
-            continue  # this guard names bench.py without driving it
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not node.name.startswith("test_"):
-                continue
-            body_src = ast.unparse(node)
-            if not any(b in body_src for b in _BENCH_DRIVERS):
-                continue
-            decorators = [ast.unparse(d) for d in node.decorator_list]
-            if not any("slow" in d for d in decorators):
-                offenders.append(f"{path.name}::{node.name}")
-    assert not offenders, (
-        "tests driving bench.py (subprocess or in-process bench_* entry "
-        "points) must be @pytest.mark.slow (tier-1 runs -m 'not slow' in "
-        f"a fixed budget): {offenders}"
-    )
-
-
-# Fault-machinery touchpoints: a test exercising these AND a heavy
-# indicator (real process spawns/kills or wall-clock sleeps) is a chaos
-# test and must not ride the default tier.
-_FAULT_MACHINERY = (
-    "FaultInjector",
-    "fault.install",
-    "PDT_FAULT_SPEC",
-    "StepWatchdog",
-    "ProcessLoaderPool",
-    "ElasticCoordinator",
-    "kill_peer",
-    "multihost_worker",
-    "MH_ELASTIC",
-)
-_HEAVY_INDICATORS = ("time.sleep(", "os.kill(", "Process(", "subprocess")
+    """Any test driving bench.py (subprocess or in-process bench_* entry
+    point) pays compiles + timed windows and must be @pytest.mark.slow —
+    the tier-1 gate runs ``-m 'not slow'`` in a fixed budget."""
+    offenders = [
+        f.format()
+        for f in _run_marker_pass().unsuppressed
+        if "without @pytest.mark.slow" in f.message
+    ]
+    assert not offenders, offenders
 
 
 def test_fault_injection_tests_are_slow_or_chaos_marked():
     """Fault-injection tests that spawn/kill real processes or wait out
-    sleep-based watchdog timers must carry ``slow`` or ``chaos`` so the
-    tier-1 gate (``-m 'not slow'``) never pays for them.  Scoped to the
-    fault machinery: ordinary subprocess tests elsewhere (e.g. the CLI
-    crash-path test) follow the bench/budget rules above, not this one."""
-    here = pathlib.Path(__file__).parent
-    offenders = []
-    for path in sorted(here.glob("test_*.py")):
-        if path.name == "test_marker_convention.py":
-            continue  # this guard names the machinery without running it
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if not node.name.startswith("test_"):
-                continue
-            body_src = ast.unparse(node)
-            if not any(m in body_src for m in _FAULT_MACHINERY):
-                continue
-            if not any(h in body_src for h in _HEAVY_INDICATORS):
-                continue
-            decorators = [ast.unparse(d) for d in node.decorator_list]
-            if not any("slow" in d or "chaos" in d for d in decorators):
-                offenders.append(f"{path.name}::{node.name}")
-    assert not offenders, (
-        "fault-injection tests that spawn processes or sleep out timers "
-        "must be @pytest.mark.slow or @pytest.mark.chaos: "
-        f"{offenders}"
-    )
-
-
-# Names that announce "I am a counter ledger".  Before the telemetry layer
-# (PR 6) each subsystem grew one of these and every snapshot had its own
-# schema; now the process registry (telemetry/registry.py) is the single
-# store and ``fault.counters()`` / ``ServingMetrics.snapshot()`` are views
-# of it.  Pattern-matched on the assigned NAME, not the value, so both
-# ``self._counters = {}`` and ``self._counters = Counter()`` trip it.
-_COUNTER_STORE_NAMES = ("_counters", "counters", "_counter_store")
-_COUNTER_STORE_VALUES = ("dict", "Counter", "defaultdict", "OrderedDict")
-
-
-def _is_counter_store(node: ast.AST) -> bool:
-    """An Assign/AnnAssign binding a counter-ish name to a fresh mapping."""
-    if isinstance(node, ast.AnnAssign):
-        targets, value = [node.target], node.value
-    elif isinstance(node, ast.Assign):
-        targets, value = node.targets, node.value
-    else:
-        return False
-    named = False
-    for t in targets:
-        name = t.attr if isinstance(t, ast.Attribute) else (
-            t.id if isinstance(t, ast.Name) else ""
-        )
-        if name in _COUNTER_STORE_NAMES or name.endswith("_counters"):
-            named = True
-    if not named:
-        return False
-    if isinstance(value, ast.Dict) and not value.keys:
-        return True  # = {}
-    if isinstance(value, ast.Call):
-        fn = value.func
-        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
-            fn.id if isinstance(fn, ast.Name) else ""
-        )
-        return fn_name in _COUNTER_STORE_VALUES
-    return False
+    sleep-based watchdog timers must carry ``slow`` or ``chaos``."""
+    offenders = [
+        f.format()
+        for f in _run_marker_pass().unsuppressed
+        if "neither @pytest.mark.slow nor @pytest.mark.chaos" in f.message
+    ]
+    assert not offenders, offenders
 
 
 def test_no_ad_hoc_counter_stores_outside_telemetry():
-    """Every package module except ``telemetry/`` must route counters
-    through the registry: assigning ``self._counters = {}`` (or a
-    ``Counter()``/``defaultdict()``) reintroduces a private ledger the
-    goodput snapshot and ``summary()`` cannot see."""
-    pkg = pathlib.Path(__file__).parent.parent / "pytorch_distributed_training_tpu"
-    offenders = []
-    for path in sorted(pkg.rglob("*.py")):
-        rel = path.relative_to(pkg)
-        if rel.parts[0] == "telemetry":
-            continue  # the one place counter stores are allowed to live
-        tree = ast.parse(path.read_text())
-        for node in ast.walk(tree):
-            if _is_counter_store(node):
-                offenders.append(f"{rel}:{node.lineno}")
-    assert not offenders, (
-        "ad-hoc counter store(s) outside telemetry/ — use "
-        "telemetry.registry (get_registry().counter(name) or a private "
-        f"MetricsRegistry for instance-local counts): {offenders}"
-    )
+    """Every package module except ``telemetry/`` (and the analyzer,
+    which names the patterns it hunts) must route counters through the
+    registry — a private ``self._counters = {}`` ledger is invisible to
+    the goodput snapshot."""
+    offenders = [
+        f.format()
+        for f in _run_marker_pass().unsuppressed
+        if "ad-hoc counter store" in f.message
+    ]
+    assert not offenders, offenders
 
 
 def test_counter_guard_covers_new_serving_modules():
     """PR 7 added serving/scheduler.py and serving/kv_pool.py; pin that
-    the package-wide counter-store scan actually reaches them (the guard
-    above globs the package tree, so a rename/move that drops them out of
-    scope should fail HERE, not silently stop scanning) and that their
-    counters route through ServingMetrics / the telemetry registry."""
-    pkg = pathlib.Path(__file__).parent.parent / "pytorch_distributed_training_tpu"
+    the package-wide counter-store scan actually reaches them (a
+    rename/move that drops them out of scope should fail HERE, not
+    silently stop scanning) and that their counters route through
+    ServingMetrics / the telemetry registry."""
     for rel in ("serving/scheduler.py", "serving/kv_pool.py"):
-        path = pkg / rel
+        path = _PKG / rel
         assert path.exists(), f"{rel} moved — update the convention guards"
-        assert path in set(pkg.rglob("*.py")), f"{rel} escaped the scan"
+        assert path in set(_PKG.rglob("*.py")), f"{rel} escaped the scan"
         tree = ast.parse(path.read_text())
         assert not [
-            node.lineno for node in ast.walk(tree) if _is_counter_store(node)
+            node.lineno for node in ast.walk(tree) if is_counter_store(node)
         ], f"{rel} grew an ad-hoc counter store"
     # the scheduler must talk to the ledger, not keep private tallies
-    sched_src = (pkg / "serving/scheduler.py").read_text()
+    sched_src = (_PKG / "serving" / "scheduler.py").read_text()
     assert "metrics.incr" in sched_src and "get_registry" in sched_src
+    # and the pass itself must be scanning this package tree: the module
+    # list the framework builds has to include both serving files
+    ctx_modules = {
+        m.rel
+        for m in analysis.collect_modules(_PKG.resolve(), _REPO.resolve())
+    }
+    assert "pytorch_distributed_training_tpu/serving/scheduler.py" in ctx_modules
+    assert "pytorch_distributed_training_tpu/serving/kv_pool.py" in ctx_modules
+
+
+def test_marker_pass_registered_in_framework():
+    """The migration keeps the rule in the default battery: dropping
+    MarkerConventionPass from ALL_PASSES would silently disable the
+    convention everywhere (CLI, bench lint, this gate)."""
+    assert MarkerConventionPass in analysis.ALL_PASSES

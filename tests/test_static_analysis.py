@@ -1,0 +1,433 @@
+"""pdt-analyze battery: the tier-1 gate plus proof every pass catches its
+seeded fixtures.
+
+Layout:
+  - the GATE: zero unsuppressed findings over the real package tree
+    (the same invariant the CLI exit code carries);
+  - per-pass clean/violation fixture pairs under tests/analysis_fixtures/
+    (violation files are never imported, only parsed; the marker-pass
+    fixture body is copied into a tmp tests dir under a ``test_*.py``
+    name so pytest never collects the seeded violations);
+  - suppression and baseline round-trips;
+  - the JSON reporter schema pin;
+  - the collective-order per-family extraction oracle (recorded in
+    PERF.md as the baseline for the step-family unification work);
+  - regression pins for the real findings this analyzer surfaced and
+    fixed (watchdog fire counter, scheduler active()).
+"""
+import ast
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from pytorch_distributed_training_tpu import analysis
+from pytorch_distributed_training_tpu.analysis import core, report
+from pytorch_distributed_training_tpu.analysis.collectives import (
+    CollectiveOrderPass,
+    extract_collective_sequences,
+)
+from pytorch_distributed_training_tpu.analysis.conventions import MarkerConventionPass
+from pytorch_distributed_training_tpu.analysis.donation import DonationSafetyPass
+from pytorch_distributed_training_tpu.analysis.locks import LockDisciplinePass
+from pytorch_distributed_training_tpu.analysis.purity import TracePurityPass
+
+REPO = pathlib.Path(__file__).parent.parent
+PKG = REPO / "pytorch_distributed_training_tpu"
+FIXTURES = pathlib.Path(__file__).parent / "analysis_fixtures"
+
+
+def _fixture_findings(pass_cls, *names):
+    """Run one pass over just the named fixture files."""
+    ctx = core.AnalysisContext(package_root=FIXTURES, repo_root=FIXTURES.parent)
+    modules = [
+        m
+        for m in core.collect_modules(FIXTURES, FIXTURES.parent)
+        if pathlib.Path(m.rel).name in names
+    ]
+    assert len(modules) == len(names), f"missing fixture(s) among {names}"
+    return pass_cls().run(modules, ctx)
+
+
+# --------------------------------------------------------------------- gate
+
+
+def test_package_tree_has_zero_unsuppressed_findings():
+    """THE gate: the analyzer over the real package tree is clean.  Any
+    new impurity in a traced closure, naked guarded access, divergent
+    collective, donation misuse, or convention break fails here."""
+    result = analysis.run()
+    assert not result.unsuppressed, "\n".join(
+        f.format() for f in result.unsuppressed
+    )
+    assert result.files_scanned > 50  # the scan really covered the tree
+
+
+# ----------------------------------------------------------- trace purity
+
+
+def test_purity_pass_flags_seeded_violations():
+    findings = _fixture_findings(TracePurityPass, "purity_violation.py")
+    messages = "\n".join(f.message for f in findings)
+    assert "time.time" in messages  # direct clock in a jitted def
+    assert "np.random.normal" in messages  # host RNG
+    assert "os.getenv" in messages  # env read via closure helper
+    assert "print" in messages  # host I/O in a built step
+    assert "global _STEP_COUNT" in messages  # module-global mutation
+    assert "random.random" in messages  # RNG in a lax.scan body
+    # the closure attribution names the helper AND its trace root
+    assert any(
+        "env_helper" in f.message and "step" in f.message for f in findings
+    )
+    assert len(findings) >= 6
+
+
+def test_purity_pass_accepts_clean_fixture():
+    assert _fixture_findings(TracePurityPass, "purity_clean.py") == []
+
+
+# --------------------------------------------------------- lock discipline
+
+
+def test_locks_pass_flags_seeded_violations():
+    findings = _fixture_findings(LockDisciplinePass, "locks_violation.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 4, msgs
+    assert any("_count written" in m and "bump" in m for m in msgs)
+    assert any("_count read" in m and "LeakyCounter.read" in m for m in msgs)
+    # the hoisted-out-of-with read in watermark()
+    assert any("_high_water read" in m and "watermark" in m for m in msgs)
+    # the nested thread-target def: lock NOT held at call time
+    assert any("_count written" in m and "start_worker" in m for m in msgs)
+
+
+def test_locks_pass_accepts_clean_fixture():
+    # _locked suffix, def-line guarded-by comment, and with-blocks all
+    # count as holding the lock; __init__ is exempt
+    assert _fixture_findings(LockDisciplinePass, "locks_clean.py") == []
+
+
+# -------------------------------------------------------- collective order
+
+
+def test_collectives_pass_flags_host_divergent_branches():
+    findings = _fixture_findings(CollectiveOrderPass, "collectives_violation.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3, msgs
+    assert any("psum" in m and "process_index" in m for m in msgs)
+    assert any("all_gather" in m and "os.environ" in m for m in msgs)
+    assert any("psum" in m and "process_count" in m for m in msgs)  # IfExp
+
+
+def test_collectives_pass_accepts_uniform_branches():
+    # config-driven branches are host-uniform: no finding
+    assert _fixture_findings(CollectiveOrderPass, "collectives_clean.py") == []
+
+
+def test_collective_extraction_reads_family_and_order():
+    seqs = extract_collective_sequences(FIXTURES, FIXTURES.parent)
+    bad = seqs["fixture-bad"]
+    assert [c.op for c in bad["build_divergent_step"]] == ["psum", "pmean"]
+    good = seqs["fixture-good"]
+    assert [c.op for c in good["build_plain_step"]] == ["psum", "pmean"]
+    assert all(c.axis == "'data'" for c in good["build_plain_step"])
+
+
+# -------------------------------------------------------- donation safety
+
+
+def test_donation_pass_flags_seeded_violations():
+    findings = _fixture_findings(DonationSafetyPass, "donation_violation.py")
+    msgs = [f.message for f in findings]
+    assert len(findings) == 3, msgs
+    assert any(
+        "`state` used after being donated to `train_step`" in m for m in msgs
+    )
+    assert any(
+        "`state` used after being donated to `apply_update`" in m for m in msgs
+    )
+    assert any("out of range" in m and "bad_arity_step" in m for m in msgs)
+
+
+def test_donation_pass_accepts_consume_and_rebind():
+    assert _fixture_findings(DonationSafetyPass, "donation_clean.py") == []
+
+
+# ------------------------------------------------------- marker convention
+
+
+def test_marker_pass_flags_seeded_test_violations(tmp_path):
+    # the fixture body is stored under a non-test name; give it a
+    # collectable name only inside the throwaway tests dir
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    shutil.copy(
+        FIXTURES / "marker_violation_body.py",
+        tests_dir / "test_seeded_markers.py",
+    )
+    ctx = core.AnalysisContext(
+        package_root=FIXTURES, repo_root=tmp_path, tests_dir=tests_dir
+    )
+    findings = MarkerConventionPass().run([], ctx)
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2, msgs
+    assert any("test_unmarked_bench_driver" in m for m in msgs)
+    assert any("test_unmarked_fault_chaos" in m for m in msgs)
+    # the properly-marked twins must NOT be flagged
+    assert not any("properly_marked" in m for m in msgs)
+
+
+def test_marker_pass_flags_counter_stores():
+    findings = _fixture_findings(
+        MarkerConventionPass, "counter_store_violation.py"
+    )
+    counter_findings = [
+        f for f in findings if "ad-hoc counter store" in f.message
+    ]
+    # self._counters = {} in __init__ and the module-level Counter()
+    assert len(counter_findings) == 2, [f.format() for f in counter_findings]
+
+
+# ----------------------------------------------------------- suppressions
+
+
+def test_suppression_trailing_and_line_above_forms():
+    ctx = core.AnalysisContext(package_root=FIXTURES, repo_root=FIXTURES.parent)
+    modules = [
+        m
+        for m in core.collect_modules(FIXTURES, FIXTURES.parent)
+        if pathlib.Path(m.rel).name == "suppression_mix.py"
+    ]
+    # run through run_passes-style folding by checking is_suppressed
+    findings = TracePurityPass().run(modules, ctx)
+    assert len(findings) == 3  # the pass itself sees all three
+    mod = modules[0]
+    live = [f for f in findings if not mod.is_suppressed(f)]
+    dropped = [f for f in findings if mod.is_suppressed(f)]
+    assert len(live) == 1 and "raw_violation" in live[0].message
+    assert len(dropped) == 2
+
+
+def test_wildcard_suppression(tmp_path):
+    src = (
+        "import time, jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x + time.time()  # pdt: ignore[*] -- fixture\n"
+    )
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    result = analysis.run(package_root=pkg)
+    assert not result.unsuppressed
+    assert len(result.suppressed) == 1
+
+
+# --------------------------------------------------------------- baseline
+
+
+def test_baseline_round_trip(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(FIXTURES / "donation_violation.py", pkg / "legacy.py")
+    first = analysis.run(package_root=pkg)
+    assert first.unsuppressed  # the violations are live...
+    bl = tmp_path / "baseline.json"
+    core.write_baseline(bl, first.unsuppressed)
+    second = analysis.run(package_root=pkg, baseline=bl)
+    assert not second.unsuppressed  # ...then adopted by the baseline
+    assert len(second.baselined) == len(first.unsuppressed)
+    # baseline keys are line-independent: prepending a comment moves
+    # every line but resurrects nothing
+    legacy = pkg / "legacy.py"
+    legacy.write_text("# moved\n" + legacy.read_text())
+    third = analysis.run(package_root=pkg, baseline=bl)
+    assert not third.unsuppressed
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    bad = tmp_path / "b.json"
+    bad.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError):
+        core.load_baseline(bad)
+
+
+# ------------------------------------------------------------ JSON schema
+
+
+def test_json_reporter_schema_pin():
+    result = analysis.run(rules=["donation-safety"])
+    payload = report.json_payload(result)
+    assert payload["version"] == 1
+    assert set(payload) == {"version", "findings", "summary"}
+    assert set(payload["summary"]) == {
+        "unsuppressed",
+        "suppressed",
+        "baselined",
+        "by_rule",
+        "files_scanned",
+        "wall_s",
+    }
+    for f in payload["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "message"}
+    # and it must be round-trippable text
+    assert json.loads(report.render_json(result)) == payload
+
+
+def test_unknown_rule_is_rejected():
+    with pytest.raises(ValueError, match="unknown rule"):
+        analysis.run(rules=["no-such-rule"])
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def test_cli_exits_zero_on_package_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_training_tpu.analysis"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pdt-analyze:" in proc.stdout
+
+
+def test_cli_exits_one_on_violations_and_emits_json(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(FIXTURES / "purity_violation.py", pkg / "mod.py")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytorch_distributed_training_tpu.analysis",
+            "--root",
+            str(pkg),
+            "--format",
+            "json",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["unsuppressed"] > 0
+
+
+# ----------------------------------------- collective-order family oracle
+
+
+def test_collective_order_oracle_matches_perf_md():
+    """The per-family collective sequences of the four step families,
+    pinned as the baseline oracle for the step-family unification work
+    (ROADMAP item 3, recorded in PERF.md).  A refactor that unifies the
+    step builders must reproduce these sequences EXACTLY — reordering or
+    dropping a collective changes multi-host semantics."""
+    seqs = extract_collective_sequences(PKG)
+    assert set(seqs) == {"dp", "sp", "tp", "pp"}
+
+    def ops(family, builder):
+        return [c.op for c in seqs[family][builder]]
+
+    assert ops("dp", "build_train_step") == ["pmean", "pmean"]
+    assert ops("dp", "build_eval_step") == ["pmean"]
+    assert ops("dp", "build_eval_step_exact") == ["psum"]
+    assert ops("sp", "build_lm_train_step") == ["psum"]
+    assert ops("sp", "build_lm_eval_step") == ["psum", "pmean"]
+    assert ops("pp", "build_pp_lm_train_step") == [
+        "ppermute",
+        "psum",
+        "ppermute",
+        "ppermute",
+        "psum",
+    ]
+    assert ops("pp", "build_pp_lm_eval_step") == [
+        "ppermute",
+        "psum",
+        "psum",
+        "psum",
+    ]
+    # TP is GSPMD-compiled: the partitioner inserts its collectives, so
+    # the static extraction legitimately sees none
+    assert seqs["tp"] == {}
+
+
+# ------------------------------------- regression pins for the real fixes
+
+
+def _method(tree, cls_name, meth_name):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if (
+                    isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and item.name == meth_name
+                ):
+                    return item
+    raise AssertionError(f"{cls_name}.{meth_name} not found")
+
+
+def test_watchdog_fire_counter_updates_under_lock():
+    """pdt-analyze finding (fixed this PR): StepWatchdog._run bumped
+    ``self.fires`` outside ``self._lock`` — a racy read-modify-write
+    against any thread polling the counter.  Pin that every ``fires``
+    write outside __init__ sits inside a with-block."""
+    src = (PKG / "engine" / "watchdog.py").read_text()
+    tree = ast.parse(src)
+    run = _method(tree, "StepWatchdog", "_run")
+    writes = [
+        n
+        for n in ast.walk(run)
+        for t in (
+            n.targets if isinstance(n, ast.Assign) else [n.target]
+            if isinstance(n, ast.AugAssign) else []
+        )
+        if isinstance(t, ast.Attribute) and t.attr == "fires"
+    ]
+    assert writes, "the fire-count bump disappeared from _run"
+    with_lines = [
+        (n.lineno, n.end_lineno) for n in ast.walk(run) if isinstance(n, ast.With)
+    ]
+    for w in writes:
+        assert any(a <= w.lineno <= b for a, b in with_lines), (
+            "self.fires bumped outside the lock again"
+        )
+    # and the declared guard means the analyzer itself now pins this too
+    ctx = core.AnalysisContext(package_root=PKG, repo_root=REPO)
+    modules = [
+        m
+        for m in core.collect_modules(PKG, REPO)
+        if m.rel.endswith("engine/watchdog.py")
+    ]
+    assert LockDisciplinePass().run(modules, ctx) == []
+
+
+def test_scheduler_active_snapshots_under_condition():
+    """pdt-analyze audit finding (fixed this PR): ContinuousScheduler
+    .active() read the slot list without the condition while
+    _fail_inflight rebinds it wholesale under the lock.  Pin that the
+    slot scan sits inside ``with self._cond``."""
+    src = (PKG / "serving" / "scheduler.py").read_text()
+    active = _method(ast.parse(src), "ContinuousScheduler", "active")
+    withs = [n for n in ast.walk(active) if isinstance(n, ast.With)]
+    assert withs, "active() no longer takes the condition"
+    guarded_src = ast.unparse(withs[0])
+    assert "self._cond" in guarded_src and "_slots" in guarded_src
+
+
+def test_framework_registers_all_five_passes():
+    rules = {cls.rule for cls in analysis.ALL_PASSES}
+    assert rules == {
+        "trace-purity",
+        "lock-discipline",
+        "collective-order",
+        "donation-safety",
+        "marker-convention",
+    }
