@@ -45,6 +45,7 @@ BENCH_DRIVERS = (
     "bench_ckpt(",
     "bench_chaos(",
     "bench_serve(",
+    "bench_chaos_serve(",
 )
 
 FAULT_MACHINERY = (

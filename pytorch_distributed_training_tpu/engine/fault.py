@@ -34,9 +34,30 @@ Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
                        in-flight overlapped save deterministically and prove
                        the deferred-error + restore-fallback contract
 
-Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``) are
-one-shot: consumed when they fire, so a rollback replay of the same step
-index does not re-trip them (the recovery itself must converge).
+Serving-side kinds (the ``step`` is the continuous scheduler's TICK
+index, 1-based — serving/scheduler.py consults the injector once per
+tick):
+
+    serve_nan@T[:S]    corrupt the KV-pool rows of the request occupying
+                       slot S (default 0) at tick T with NaNs — the
+                       on-device output guard must evict exactly that
+                       request, bit-exact for every other slot
+    serve_raise@T[:S]  the request in slot S (default 0) raises from the
+                       decode dispatch at tick T — the poison-bisect path
+                       must isolate it without failing the world
+    serve_device_lost@T
+                       raise :class:`DeviceLostError` from tick T's decode
+                       dispatch — the supervisor must hot-restart the
+                       engine and replay every in-flight request
+                       token-identically
+    serve_hang@T[:SEC] sleep SEC (default 1.0) inside tick T — the tick
+                       watchdog must fire and convert the stall into a
+                       diagnosed restart
+
+Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/the
+``serve_*`` family) are one-shot: consumed when they fire, so a rollback
+replay of the same step index does not re-trip them (the recovery itself
+must converge).
 
 This module is import-light on purpose (stdlib only): the data pipeline and
 serving stack consult it without pulling the JAX engine in.  The recovery
@@ -57,6 +78,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "ENV_VAR",
+    "DeviceLostError",
     "FaultInjectionError",
     "FaultInjector",
     "get_injector",
@@ -68,7 +90,10 @@ __all__ = [
 
 ENV_VAR = "PDT_FAULT_SPEC"
 
-_STEP_KINDS = ("nan_batch", "kill_worker", "stall_step", "kill_peer")
+_STEP_KINDS = (
+    "nan_batch", "kill_worker", "stall_step", "kill_peer",
+    "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
+)
 _POINT_KINDS = {
     "ckpt_fail": "ckpt_save",
     "restore_fail": "ckpt_restore",
@@ -82,6 +107,15 @@ class FaultInjectionError(OSError):
     Subclasses ``OSError`` so it lands in the default retry allowlist
     (``utils.retry.Retry``) exactly like the transient filesystem errors it
     stands in for.
+    """
+
+
+class DeviceLostError(FaultInjectionError):
+    """Injected stand-in for losing the accelerator mid-dispatch.
+
+    The serving supervisor classifies it (and real ``XlaRuntimeError``s)
+    as non-attributable: no single request caused it, so the recovery is
+    hot-restart + replay rather than poison-bisect.
     """
 
 
@@ -123,17 +157,18 @@ class FaultInjector:
                 )
             self._fail_windows.setdefault(_POINT_KINDS[kind], []).append((step, n))
         elif kind in _STEP_KINDS:
-            if kind == "kill_worker":
+            if kind in ("kill_worker", "serve_nan", "serve_raise"):
+                # arg = worker index / scheduler slot index (default 0)
                 val = float(int(arg)) if arg is not None else 0.0
             elif kind == "kill_peer":
                 # arg = target process index; -1 = whichever rank parses it
                 val = float(int(arg)) if arg is not None else -1.0
-            elif kind == "stall_step":
+            elif kind in ("stall_step", "serve_hang"):
                 val = float(arg) if arg is not None else 1.0
-            else:  # nan_batch takes no arg
+            else:  # nan_batch / serve_device_lost take no arg
                 if arg is not None:
                     raise ValueError(
-                        f"bad {ENV_VAR} entry {entry!r}: nan_batch takes no arg"
+                        f"bad {ENV_VAR} entry {entry!r}: {kind} takes no arg"
                     )
                 val = 1.0
             self._step_faults[kind][step] = val
@@ -150,8 +185,9 @@ class FaultInjector:
     def take(self, kind: str, step: int) -> Optional[float]:
         """Consume the one-shot fault ``kind@step``; None when absent.
 
-        Returns the entry's arg (worker index for ``kill_worker``, stall
-        seconds for ``stall_step``, 1.0 for ``nan_batch``).
+        Returns the entry's arg (worker index for ``kill_worker``, slot
+        index for ``serve_nan``/``serve_raise``, stall seconds for
+        ``stall_step``/``serve_hang``, 1.0 for the no-arg kinds).
         """
         with self._lock:
             return self._step_faults[kind].pop(int(step), None)

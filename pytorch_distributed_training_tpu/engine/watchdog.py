@@ -91,6 +91,19 @@ class StepWatchdog:
             self._times.append(time.monotonic() - self._cur_start)
             self._cur_step = None
 
+    def reset(self) -> None:
+        """Forget trailing history and re-enter warmup.
+
+        For restart paths that recompile their programs (the serving hot
+        restart): the first post-rebuild steps legitimately take compile-
+        scale wall time, and judging them against the pre-restart median
+        would turn the recovery itself into another false hang.
+        """
+        with self._lock:
+            self._times.clear()
+            self._cur_step = None
+            self._fired_for = None
+
     def trailing_median(self) -> Optional[float]:
         with self._lock:
             return statistics.median(self._times) if self._times else None

@@ -369,5 +369,15 @@ class MultiHeadAttention(nn.Module):
         )  # [B, S, L]; padding queries keep key 0 live so softmax stays finite
         logits = jnp.where(live[:, None], logits, float("-inf"))
         p = jnp.asarray(nn.softmax(logits, axis=-1))
-        out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+        # zero non-live VALUES too, not just their softmax weight: a NaN in
+        # a dead gathered row (padded block-table entries alias block 0;
+        # recycled blocks keep an evicted request's contents) would
+        # otherwise leak through the contraction as 0 * NaN = NaN — the
+        # serving output guard depends on NaN staying confined to the row
+        # that produced it.  Causal mask => a position live for any query
+        # of the row is live for its last one, so reduce over S.
+        cv = jnp.where(
+            live.any(axis=1)[:, :, None, None], cv.astype(jnp.float32), 0.0
+        )
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, cv)
         return out.astype(q.dtype)

@@ -14,6 +14,9 @@ lever — saturate the accelerator by batching — to inference:
     (continuous) batching — slot array + per-step retire-and-refill.
   - :mod:`.kv_pool`  — :class:`PagedKVPool`: block allocator, admission
     control, and prefix cache behind the paged attention mode.
+  - :mod:`.resilience` — :class:`ServingSupervisor`: poison-bisect
+    request isolation, bounded hot-restart with token-identical replay,
+    drain/health lifecycle.
 
 ``python -m pytorch_distributed_training_tpu.serving --config
 config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
@@ -23,15 +26,25 @@ from .decode import build_generate_fn, build_paged_fns
 from .engine import InferenceEngine
 from .kv_pool import BlockAllocator, PagedKVPool
 from .metrics import ServingMetrics
+from .resilience import (
+    EngineRestartError,
+    HungTickError,
+    PoisonedRequestError,
+    ServingSupervisor,
+)
 from .scheduler import ContinuousScheduler
 
 __all__ = [
     "BlockAllocator",
     "ContinuousScheduler",
     "DynamicBatcher",
+    "EngineRestartError",
+    "HungTickError",
     "InferenceEngine",
     "PagedKVPool",
+    "PoisonedRequestError",
     "ServingMetrics",
+    "ServingSupervisor",
     "build_generate_fn",
     "build_paged_fns",
 ]

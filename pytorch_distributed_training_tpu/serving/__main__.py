@@ -56,6 +56,11 @@ def main(argv=None) -> int:
     logger = listener.get_logger()
     try:
         with InferenceEngine.from_config(cfg, logger=logger) as engine:
+            # SIGTERM -> graceful drain (stop admitting, finish in-flight
+            # under resilience.drain_deadline_ms, then close) — the
+            # orchestrated-shutdown path, wired here because signal
+            # handlers must install from the main thread
+            engine.install_drain_handler()
             logger.info(
                 "engine up: task=%s batch_buckets=%s seq_buckets=%s",
                 "lm" if engine.is_lm else "image",

@@ -192,12 +192,20 @@ class _PagedFns:
     """Jit pair + pool factory for the paged (block-table) cache mode.
 
     ``prefill(params, pool, tokens, positions, block_tables, last_col,
-    row_keys) -> (tok0, pool)`` — scatter the suffix K/V into the pool and
-    sample each row's first token from the logits at ``last_col``.
+    row_keys, gen_index) -> (tok, finite, pool)`` — scatter the suffix K/V
+    into the pool and sample each row's token ``gen_index[r]`` from the
+    logits at ``last_col`` (0 for a fresh prompt; the hot-restart replay
+    path passes the index of the last already-delivered token so the
+    resample is bitwise reproducible).
     ``decode_step(params, pool, prev_tok, pos, block_tables, row_keys,
-    gen_index) -> (tok, pool)`` — ONE single-token step for every slot;
-    the scheduler's host loop supplies fresh inputs per iteration, so this
-    one program serves any mix of in-flight requests.
+    gen_index) -> (tok, finite, pool)`` — ONE single-token step for every
+    slot; the scheduler's host loop supplies fresh inputs per iteration,
+    so this one program serves any mix of in-flight requests.
+    ``finite`` [B] bool is the on-device output guard: True iff every
+    logit the row sampled from is finite — the serving mirror of the
+    training anomaly guard, letting the scheduler evict a NaN-producing
+    request without a Python exception (padding rows read stale pool
+    rows, so only ACTIVE rows' flags are meaningful).
     ``init_pool(params)`` — the zero pool pytree (``jax.eval_shape`` over
     the apply: correct flax cache paths, no throwaway compile).
     """
@@ -250,14 +258,17 @@ def build_paged_fns(
     sample = _make_sampler(temperature)
 
     @jax.jit
-    def prefill(params, pool, tokens, positions, block_tables, last_col, row_keys):
+    def prefill(
+        params, pool, tokens, positions, block_tables, last_col, row_keys,
+        gen_index,
+    ):
         logits, variables = paged_model.apply(
             {"params": params, "cache": pool},
             tokens, positions, block_tables, mutable=["cache"],
         )
         last = jnp.take_along_axis(logits, last_col[:, None, None], axis=1)[:, 0]
-        tok = sample(last, _token_keys(row_keys, 0))
-        return tok, variables["cache"]
+        tok = sample(last, _token_keys(row_keys, gen_index))
+        return tok, jnp.isfinite(last).all(axis=-1), variables["cache"]
 
     @jax.jit
     def decode_step(params, pool, prev_tok, pos, block_tables, row_keys, gen_index):
@@ -266,7 +277,7 @@ def build_paged_fns(
             prev_tok[:, None], pos[:, None], block_tables, mutable=["cache"],
         )
         tok = sample(logits[:, 0], _token_keys(row_keys, gen_index))
-        return tok, variables["cache"]
+        return tok, jnp.isfinite(logits[:, 0]).all(axis=-1), variables["cache"]
 
     def init_pool(params):
         # any concrete shapes work — the pool's shape depends only on the

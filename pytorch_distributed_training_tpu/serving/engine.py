@@ -79,6 +79,7 @@ class InferenceEngine:
         input_norm=None,
         seed: int = 0,
         scheduler: Optional[Dict[str, Any]] = None,
+        resilience: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
     ):
         self.model = model
@@ -150,6 +151,7 @@ class InferenceEngine:
                 metrics=self.metrics,
                 seed=seed,
                 pool_sharding=rep,
+                resilience=resilience,
                 logger=self.logger,
             )
             if sched_cfg:
@@ -157,6 +159,13 @@ class InferenceEngine:
                     f"unknown serving.scheduler keys: {sorted(sched_cfg)}"
                 )
         else:
+            if resilience is not None:
+                raise ValueError(
+                    "serving.resilience requires serving.scheduler.enabled "
+                    "— the batcher path has no supervisor (poison-bisect, "
+                    "hot-restart and replay all live in the continuous "
+                    "scheduler)"
+                )
             self.batcher = DynamicBatcher(
                 self._run_batch, max_batch_size, max_delay_ms,
                 deadline_ms=deadline_ms, max_backlog=max_backlog,
@@ -238,6 +247,7 @@ class InferenceEngine:
             input_norm=input_norm,
             seed=int(serve.get("seed", 0)),
             scheduler=serve.get("scheduler"),
+            resilience=serve.get("resilience"),
             logger=logger,
         )
 
@@ -315,6 +325,56 @@ class InferenceEngine:
             return self.scheduler.compile_count()
         fn = self._generate if self.is_lm else self._classify
         return fn._cache_size()
+
+    def drain(self, deadline_ms: Optional[float] = None) -> float:
+        """Graceful shutdown: stop admitting, finish in-flight, close.
+
+        Returns wall ms spent.  On the scheduler path the drain is
+        deadline-bounded (``serving.resilience.drain_deadline_ms`` or the
+        override); the batcher path has no admission gate beyond
+        ``close()``'s synchronous flush, so drain == close there.
+        """
+        if self.scheduler is not None:
+            return self.scheduler.drain(deadline_ms)
+        import time
+
+        t0 = time.monotonic()
+        self.batcher.close()
+        return (time.monotonic() - t0) * 1000.0
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness/liveness snapshot for orchestration probes."""
+        if self.scheduler is not None:
+            return self.scheduler.health()
+        return {
+            "ready": True,
+            "live": True,
+            "queue_depth": self.batcher.depth(),
+        }
+
+    def install_drain_handler(self, signum=None) -> None:
+        """Route SIGTERM (or ``signum``) to a graceful :meth:`drain`.
+
+        The handler only spawns a daemon thread — drain joins the
+        scheduler thread, which a signal handler must not do inline
+        (handlers run ON the main thread, possibly inside scheduler-
+        adjacent code).  Call from the main thread (signal.signal's own
+        requirement).
+        """
+        import signal
+        import threading
+
+        signum = signal.SIGTERM if signum is None else signum
+
+        def _handler(sig, frame):
+            self.logger.warning(
+                "signal %s received — draining serving engine", sig
+            )
+            threading.Thread(
+                target=self.drain, name="serving-drain", daemon=True
+            ).start()
+
+        signal.signal(signum, _handler)
 
     def close(self) -> None:
         if self.scheduler is not None:

@@ -204,6 +204,40 @@ class PagedKVPool:
                 del self._ref[b]
                 self._alloc.free([b])
 
+    def check_invariants(self) -> None:
+        """Assert the pool's accounting is consistent (test hook).
+
+        Called by the resilience tests after every tick across fault
+        scenarios — an eviction or restart path that leaks a block or a
+        refcount shows up here immediately instead of as a slow pool
+        exhaustion.  Raises ``AssertionError`` on the first violation.
+        """
+        allocated = self._alloc._allocated
+        free = set(self._alloc._free)
+        assert not (allocated & free), (
+            f"blocks both allocated and free: {sorted(allocated & free)}"
+        )
+        assert len(free) == len(self._alloc._free), "duplicate free-list entries"
+        everything = allocated | free
+        expected = set(range(self.num_blocks))
+        assert everything == expected, (
+            f"lost blocks: {sorted(expected - everything)}"
+        )
+        assert set(self._ref) == allocated, (
+            f"refcount/allocation mismatch: refs without allocation "
+            f"{sorted(set(self._ref) - allocated)}, allocation without refs "
+            f"{sorted(allocated - set(self._ref))}"
+        )
+        assert all(v >= 1 for v in self._ref.values()), (
+            f"non-positive refcounts: "
+            f"{ {b: v for b, v in self._ref.items() if v < 1} }"
+        )
+        cached = set(self._cache.values())
+        assert cached <= set(self._ref), (
+            f"cache entries pointing at unallocated blocks: "
+            f"{sorted(cached - set(self._ref))}"
+        )
+
     def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
         if n == 0:
             return []

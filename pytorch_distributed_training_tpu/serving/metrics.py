@@ -149,6 +149,21 @@ class ServingMetrics:
         with self._lock:
             self._max_depth = max(self._max_depth, depth)
 
+    def record_health(self, health: Dict[str, object]) -> None:
+        """Mirror a scheduler health snapshot into ``health_*`` gauges.
+
+        The prefix keeps gauges out of the counter namespace
+        (``engine_restarts`` is already a counter in this registry and
+        :class:`MetricsRegistry` rejects cross-type name reuse).  Only
+        numeric/bool fields are mirrored; a None ``last_tick_age_s``
+        (no tick yet) is skipped rather than encoded as a sentinel.
+        """
+        for key, val in health.items():
+            if isinstance(val, bool):
+                self._registry.gauge(f"health_{key}").set(1.0 if val else 0.0)
+            elif isinstance(val, (int, float)):
+                self._registry.gauge(f"health_{key}").set(float(val))
+
     def snapshot(self) -> Dict[str, float]:
         """Aggregate view: p50/p99 latency, items/sec, batch occupancy."""
         lat = self._latency_ms.snapshot()
@@ -209,6 +224,11 @@ class ServingMetrics:
         misses = counters.get("prefix_miss_blocks", 0)
         if hits + misses:
             out["prefix_hit_rate"] = float(hits / (hits + misses))
+        # health gauges ride along once record_health has run (absent
+        # otherwise, keeping pre-resilience snapshots byte-stable)
+        gauges = self._registry.snapshot()["gauges"]
+        for name, g in gauges.items():
+            out[name] = float(g["value"])
         return out
 
     def log_summary(self, logger, prefix: str = "serving") -> Dict[str, float]:

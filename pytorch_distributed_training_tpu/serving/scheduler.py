@@ -28,6 +28,19 @@ accounting.  Counters flow through :class:`ServingMetrics` and are
 mirrored into the process telemetry registry (``serving_*``) so the
 one-ledger rule holds.
 
+Fault tolerance (PR 9, serving/resilience.py): a tick exception no
+longer fails the world — :class:`ServingSupervisor` classifies it and
+either evicts the one poisoned request (poison-bisect over
+``_decode_probe``, or the on-device ``isfinite`` output guard for NaN
+emitters) or hot-restarts the engine, rebuilding the compiled programs
+and pool and replaying every in-flight request token-identically
+(``_replay``; the per-row per-token-index ``fold_in`` keys make the
+resample bitwise reproducible).  ``drain()`` gives SIGTERM a bounded
+graceful shutdown and ``health()`` the readiness/liveness snapshot; an
+optional tick watchdog (engine/watchdog.py) turns a hung step into a
+diagnosed restart.  The ``serve_*`` kinds in engine/fault.py drive all
+of it deterministically.
+
 Single-process by design (for now): inputs are handed to jit uncommitted
 rather than sharded over the mesh — multi-host serving stays on the
 batcher path until the scheduler learns sharded block tables.
@@ -49,11 +62,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..engine import fault
+from ..engine.watchdog import StepWatchdog
 from ..telemetry.registry import get_registry
 from .batcher import OverloadedError
 from .decode import build_paged_fns
 from .kv_pool import PagedKVPool
 from .metrics import ServingMetrics
+from .resilience import HungTickError, PoisonedRequestError, ServingSupervisor
 
 __all__ = ["ContinuousScheduler"]
 
@@ -63,7 +79,7 @@ class _PagedRequest:
 
     __slots__ = (
         "prompt", "max_new", "future", "enqueued_at", "deadline",
-        "on_token", "row_key", "admission", "slot", "tokens",
+        "on_token", "row_key", "admission", "slot", "tokens", "poison",
     )
 
     def __init__(self, prompt, max_new, deadline, on_token, row_key):
@@ -77,6 +93,7 @@ class _PagedRequest:
         self.admission = None  # set when a slot admits us
         self.slot = -1
         self.tokens: List[int] = []
+        self.poison = None  # fault-injection marker ("raise")
 
     @property
     def gen_idx(self) -> int:
@@ -112,6 +129,7 @@ class ContinuousScheduler:
         metrics: Optional[ServingMetrics] = None,
         seed: int = 0,
         pool_sharding=None,
+        resilience: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
         start: bool = True,
     ):
@@ -143,6 +161,15 @@ class ContinuousScheduler:
         self.max_backlog = max_backlog
         self.logger = logger or logging.getLogger(__name__)
         self.metrics = metrics or ServingMetrics()
+
+        # kept for hot-restart: _rebuild_and_requeue reconstructs the
+        # compiled programs and the pool from the same ingredients
+        self._model = model
+        self._temperature = float(temperature)
+        self._block_size = int(block_size)
+        self._num_blocks = int(num_blocks)
+        self._prefix_cache = bool(prefix_cache)
+        self._pool_sharding = pool_sharding
 
         self._kv = PagedKVPool(num_blocks, block_size, prefix_cache)
         # every block table is padded to the worst-case footprint so the
@@ -179,6 +206,53 @@ class ContinuousScheduler:
         self._queue: "deque[_PagedRequest]" = deque()  # guarded by: self._cond
         self._cond = threading.Condition()
         self._closed = False  # guarded by: self._cond
+        self._draining = False  # guarded by: self._cond
+        self._drain_deadline: Optional[float] = None  # guarded by: self._cond
+        self._last_tick: Optional[float] = None  # guarded by: self._cond
+        self._hang_info = None  # guarded by: self._cond
+
+        # tick-thread-confined recovery state (supervisor runs inside
+        # tick's except clause, on the same thread)
+        self._tick_no = 0
+        self._tick_phase = ""
+
+        res = dict(resilience or {})
+        wd = dict(res.pop("watchdog", None) or {})
+        self.drain_deadline_ms = res.pop("drain_deadline_ms", None)
+        if self.drain_deadline_ms is not None:
+            self.drain_deadline_ms = float(self.drain_deadline_ms)
+            if self.drain_deadline_ms <= 0:
+                raise ValueError(
+                    f"drain_deadline_ms must be > 0, got {self.drain_deadline_ms}"
+                )
+        self._supervisor = ServingSupervisor(
+            self,
+            max_restarts=int(res.pop("max_restarts", 2)),
+            poison_bisect=bool(res.pop("poison_bisect", True)),
+            logger=self.logger,
+        )
+        if res:
+            raise ValueError(f"unknown serving.resilience keys: {sorted(res)}")
+        wd_enabled = bool(wd.pop("enabled", False))
+        wd_factor = float(wd.pop("factor", 10.0))
+        wd_min_seconds = float(wd.pop("min_seconds", 60.0))
+        wd_warmup = int(wd.pop("warmup", 3))
+        wd_poll = wd.pop("poll_seconds", None)
+        if wd:
+            raise ValueError(
+                f"unknown serving.resilience.watchdog keys: {sorted(wd)}"
+            )
+        self._watchdog: Optional[StepWatchdog] = None
+        if wd_enabled:
+            self._watchdog = StepWatchdog(
+                factor=wd_factor,
+                min_seconds=wd_min_seconds,
+                warmup=wd_warmup,
+                poll_seconds=wd_poll,
+                on_hang=self._on_tick_hang,
+                logger=self.logger,
+            )
+
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -227,6 +301,10 @@ class ContinuousScheduler:
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
+            if self._draining:
+                raise RuntimeError(
+                    "scheduler is draining; not accepting new requests"
+                )
             # sweep expired entries BEFORE the backlog check so live
             # requests are never shed to protect doomed ones (the
             # DynamicBatcher bug this PR also fixes)
@@ -274,6 +352,69 @@ class ContinuousScheduler:
         does."""
         return self._fns._cache_size()
 
+    def drain(self, deadline_ms: Optional[float] = None) -> float:
+        """Graceful shutdown: stop admitting NEW submissions, finish the
+        queued + in-flight work, then close.  Returns wall ms spent.
+
+        Past ``deadline_ms`` (default ``resilience.drain_deadline_ms``;
+        None = unbounded) the next tick fails the remaining requests with
+        ``TimeoutError`` and the drain completes — bounded, like every
+        other recovery path.  Safe from any thread; idempotent.
+        """
+        t0 = time.monotonic()
+        dl = deadline_ms if deadline_ms is not None else self.drain_deadline_ms
+        with self._cond:
+            if self._closed:
+                return 0.0
+            self._draining = True
+            if dl is not None:
+                self._drain_deadline = t0 + dl / 1000.0
+            self._cond.notify_all()
+        if self._thread is None:
+            while self.tick():
+                pass
+        else:
+            with self._cond:
+                while not self._closed and (
+                    self._queue or any(s is not None for s in self._slots)
+                ):
+                    # the loop thread does the work (and enforces the
+                    # deadline inside tick); this is just a progress watch
+                    self._cond.wait(timeout=0.01)
+        self.close()
+        return (time.monotonic() - t0) * 1000.0
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness/liveness snapshot for orchestration probes.
+
+        ``ready`` = accepting submissions; ``live`` = worth keeping the
+        process (False once the restart budget is exhausted).  Mirrored
+        into :class:`ServingMetrics` gauges (``health_*``) so one metrics
+        snapshot carries health alongside latency/throughput.
+        """
+        now = time.monotonic()
+        with self._cond:
+            depth = len(self._queue)
+            active = sum(1 for s in self._slots if s is not None)
+            closed = self._closed
+            draining = self._draining
+            last = self._last_tick
+        restarts = self._supervisor.restarts()
+        exhausted = self._supervisor.exhausted()
+        snap = {
+            "ready": not (closed or draining or exhausted),
+            "live": not exhausted,
+            "queue_depth": depth,
+            "active_slots": active,
+            "engine_restarts": restarts,
+            "restart_budget": self._supervisor.max_restarts,
+            "last_tick_age_s": (now - last) if last is not None else None,
+            "draining": draining,
+            "closed": closed,
+        }
+        self.metrics.record_health(snap)
+        return snap
+
     def close(self) -> None:
         """Drain queue and in-flight slots, then stop the loop."""
         with self._cond:
@@ -287,6 +428,8 @@ class ContinuousScheduler:
             # test mode (start=False): drain synchronously
             while self.tick():
                 pass
+        if self._watchdog is not None:
+            self._watchdog.close()
 
     def __enter__(self):
         return self
@@ -303,13 +446,67 @@ class ContinuousScheduler:
         """One scheduler iteration: admit+prefill, then one decode step.
 
         Returns True if any work happened (the synchronous drain in
-        ``close`` loops on it).
+        ``close`` loops on it).  A failing tick is handed to the
+        supervisor, which evicts the poisoned request or hot-restarts —
+        the caller never sees the exception unless recovery itself dies.
         """
+        self._tick_no += 1
+        self._tick_phase = "setup"
+        if self._watchdog is not None:
+            self._watchdog.step_started(self._tick_no)
+        try:
+            try:
+                did = self._tick_inner()
+            finally:
+                if self._watchdog is not None:
+                    self._watchdog.step_finished()
+                with self._cond:
+                    self._last_tick = time.monotonic()
+            with self._cond:
+                hang, self._hang_info = self._hang_info, None
+            if hang is not None and hang[0] == self._tick_no:
+                raise HungTickError(
+                    f"scheduler tick {hang[0]} ran {hang[1]:.2f}s "
+                    f"(watchdog limit {hang[2]:.2f}s)"
+                )
+            return did
+        except Exception as exc:
+            self.logger.exception(
+                "scheduler tick %d failed in phase %r; invoking supervisor",
+                self._tick_no, self._tick_phase,
+            )
+            return self._supervisor.handle_tick_failure(exc)
+
+    def _tick_inner(self) -> bool:
+        with self._cond:
+            expired = (
+                self._draining
+                and self._drain_deadline is not None
+                and time.monotonic() >= self._drain_deadline
+                and (
+                    bool(self._queue)
+                    or any(s is not None for s in self._slots)
+                )
+            )
+        if expired:
+            self._bump("drain_expired")
+            self._fail_inflight(
+                TimeoutError(
+                    "graceful drain exceeded its deadline; failing the "
+                    "remaining requests"
+                )
+            )
+            return True
+        self._tick_phase = "admit"
         newly = self._admit()
+        self._tick_phase = "prefill"
         if newly:
             self._prefill(newly)
+        self._tick_phase = "inject"
+        self._consult_injector()
         n_active = self.active()
         if n_active:
+            self._tick_phase = "decode"
             self._decode_step()
         return bool(newly) or n_active > 0
 
@@ -376,7 +573,21 @@ class ContinuousScheduler:
         raise ValueError(f"{kind} {n} exceeds largest bucket {buckets[-1]}")
 
     def _prefill(self, newly: List[_PagedRequest]) -> None:
-        """One bucketed prefill over every request admitted this tick.
+        """Prefill every request admitted this tick.
+
+        Fresh requests (no tokens yet) go through one bucketed batch
+        call; requests re-admitted by a hot-restart carry their delivered
+        token stream and take the replay path instead.
+        """
+        replay = [r for r in newly if r.tokens]
+        fresh = [r for r in newly if not r.tokens]
+        if fresh:
+            self._prefill_fresh(fresh)
+        if replay:
+            self._replay(replay)
+
+    def _prefill_fresh(self, newly: List[_PagedRequest]) -> None:
+        """One bucketed prefill over the fresh admissions of this tick.
 
         Prefix-cache hits shorten the device work directly: only the
         SUFFIX past ``cached_len`` is fed (positions ``cached_len ..
@@ -398,13 +609,21 @@ class ContinuousScheduler:
             tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
             last_col[i] = suffix[i] - 1
             keys[i] = req.row_key
-        tok, self._pool = self._fns.prefill(
+        tok, finite, self._pool = self._fns.prefill(
             self.params, self._pool, tokens, positions, tables,
-            last_col, jnp.stack(keys),
+            last_col, jnp.stack(keys), np.zeros((bb,), np.int32),
         )
         tok = np.asarray(tok)
+        finite = np.asarray(finite)
         t1 = time.perf_counter()
         for i, req in enumerate(newly):
+            if not finite[i]:
+                # output guard: this prompt produced non-finite logits —
+                # evict it (and keep its blocks out of the prefix cache)
+                self._evict_poisoned(
+                    req, cause=None, trigger="non-finite prefill logits"
+                )
+                continue
             # blocks are filled now — publish them for future prefix hits
             # BEFORE this request can retire and release them
             self._kv.register_prefix(req.prompt.tolist(), req.admission)
@@ -414,20 +633,189 @@ class ContinuousScheduler:
             prefill_s=t1 - t0,
         )
 
-    def _decode_step(self) -> None:
-        """One single-token step for every occupied slot."""
-        t0 = time.perf_counter()
+    def _replay(self, reqs: List[_PagedRequest]) -> None:
+        """Rebuild restart-surviving requests' KV state bit-exactly.
+
+        Prompt K/V comes back through the bucketed prefill (prefix-cache
+        hits shorten it exactly like a fresh admission); the already-
+        delivered generated tokens are then re-fed through the SAME
+        decode program that produced them.  Per-row per-token-index
+        sampling keys make every resampled token bitwise identical to
+        the stored stream — verified per token, never re-delivered
+        (clients already hold these tokens; ``on_token`` does not refire).
+        """
+        suffix = [r.prompt.size - r.admission.cached_len for r in reqs]
+        bb = self._bucket_for(len(reqs), self.batch_buckets, "replayed rows")
+        sb = self._bucket_for(max(suffix), self.seq_buckets, "replay suffix")
+        tokens = np.zeros((bb, sb), np.int32)
+        positions = np.full((bb, sb), -1, np.int32)
+        tables = np.zeros((bb, self.table_blocks), np.int32)
+        last_col = np.zeros((bb,), np.int32)
+        keys = [self._pad_key] * bb
+        for i, req in enumerate(reqs):
+            cl = req.admission.cached_len
+            tokens[i, : suffix[i]] = req.prompt[cl:]
+            positions[i, : suffix[i]] = np.arange(cl, req.prompt.size)
+            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            last_col[i] = suffix[i] - 1
+            keys[i] = req.row_key
+        tok, finite, self._pool = self._fns.prefill(
+            self.params, self._pool, tokens, positions, tables,
+            last_col, jnp.stack(keys), np.zeros((bb,), np.int32),
+        )
+        tok = np.asarray(tok)
+        finite = np.asarray(finite)
+        live: List[_PagedRequest] = []
+        for i, req in enumerate(reqs):
+            if not finite[i]:
+                self._evict_poisoned(
+                    req, cause=None, trigger="non-finite replay prefill logits"
+                )
+                continue
+            self._kv.register_prefix(req.prompt.tolist(), req.admission)
+            self._verify_replay(req, 0, int(tok[i]))
+            live.append(req)
+        # feed generated tokens 0..K-2 back through the decode program,
+        # re-verifying tokens 1..K-1 — identical per-row inputs through
+        # the identical program reproduce the original run's writes
+        max_gen = max((r.gen_idx for r in live), default=0)
+        for k in range(1, max_gen):
+            step_reqs = [r for r in live if r.gen_idx > k]
+            if not step_reqs:
+                break
+            W = self.slots_n
+            prev = np.zeros((W,), np.int32)
+            pos = np.full((W,), -1, np.int32)
+            tables = np.zeros((W, self.table_blocks), np.int32)
+            gi = np.zeros((W,), np.int32)
+            keys = [self._pad_key] * W
+            for req in step_reqs:
+                i = req.slot
+                prev[i] = req.tokens[k - 1]
+                pos[i] = req.prompt.size + k - 1
+                tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+                gi[i] = k
+                keys[i] = req.row_key
+            tok, finite, self._pool = self._fns.decode_step(
+                self.params, self._pool, prev, pos, tables, jnp.stack(keys), gi,
+            )
+            tok = np.asarray(tok)
+            finite = np.asarray(finite)
+            for req in step_reqs:
+                if not finite[req.slot]:
+                    self._evict_poisoned(
+                        req, cause=None,
+                        trigger="non-finite replay decode logits",
+                    )
+                    live.remove(req)
+                    continue
+                self._verify_replay(req, k, int(tok[req.slot]))
+        for req in live:
+            self._bump("replayed_tokens", req.gen_idx)
+
+    def _verify_replay(self, req: _PagedRequest, idx: int, tok: int) -> None:
+        """Replay parity check: the resample must equal what the client
+        already received.  A mismatch is counted and logged but the
+        DELIVERED stream stays authoritative."""
+        if tok != req.tokens[idx]:
+            self._bump("replay_parity_mismatch")
+            self.logger.error(
+                "replay divergence: slot %d generated token %d resampled as "
+                "%d but %d was delivered (keeping the delivered stream)",
+                req.slot, idx, tok, req.tokens[idx],
+            )
+
+    # ------------------------------------------------------------------ #
+    # fault injection (engine/fault.py serve_* kinds) — consulted once per
+    # tick, after admissions so the slot targets exist
+
+    def _consult_injector(self) -> None:
+        inj = fault.get_injector()
+        if not inj.active:
+            return
+        t = self._tick_no
+        sec = inj.take("serve_hang", t)
+        if sec is not None:
+            fault.bump("injected_serve_hangs")
+            self.logger.warning(
+                "fault injection: hanging tick %d for %.2fs", t, sec
+            )
+            time.sleep(sec)
+        slot = inj.take("serve_raise", t)
+        if slot is not None:
+            req = self._slot_target(int(slot), "serve_raise")
+            if req is not None:
+                fault.bump("injected_serve_raises")
+                req.poison = "raise"
+        slot = inj.take("serve_nan", t)
+        if slot is not None:
+            req = self._slot_target(int(slot), "serve_nan")
+            if req is not None:
+                fault.bump("injected_serve_nans")
+                self._corrupt_pool_rows(req)
+        if inj.take("serve_device_lost", t) is not None:
+            fault.bump("injected_serve_device_lost")
+            raise fault.DeviceLostError(
+                f"injected device loss at serving tick {t}"
+            )
+
+    def _slot_target(self, slot: int, kind: str) -> Optional[_PagedRequest]:
+        req = self._slots[slot] if 0 <= slot < self.slots_n else None
+        if req is None:
+            self.logger.warning(
+                "fault injection: %s@%d targets empty slot %d; dropped",
+                kind, self._tick_no, slot,
+            )
+        return req
+
+    def _corrupt_pool_rows(self, req: _PagedRequest) -> None:
+        """NaN the KEY-pool row of ``req``'s last WRITTEN position.
+
+        That position's block sits past the prefix-cache registration cap
+        ((prompt_len-1)//block_size), so it is exclusively owned — the
+        poison is per-request by construction.  Only ``k_pool`` rows are
+        corrupted: a NaN key makes the OWNER's attention logits NaN
+        (position is live for it) while every other reader — including a
+        later request recycling the freed block — masks it to -inf before
+        the softmax.  A NaN VALUE row would leak through recycling: masked
+        positions get exactly-zero softmax weight, and 0 * NaN is NaN in
+        the value contraction.
+        """
+        bs = self._kv.block_size
+        p = req.prompt.size + max(req.gen_idx, 1) - 2
+        row = req.admission.block_ids[p // bs] * bs + p % bs
+        n_rows = self._kv.num_blocks * bs
+
+        def corrupt(path, leaf):
+            names = {
+                str(getattr(part, "key", getattr(part, "name", "")))
+                for part in path
+            }
+            if (
+                "k_pool" in names
+                and hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] == n_rows
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+            ):
+                return leaf.at[row].set(jnp.nan)
+            return leaf
+
+        self._pool = jax.tree_util.tree_map_with_path(corrupt, self._pool)
+
+    # ------------------------------------------------------------------ #
+    # decode
+
+    def _decode_arrays(self, reqs: List[_PagedRequest]):
+        """Fixed-width decode inputs with ``reqs`` live and every other
+        slot riding along at position -1."""
         W = self.slots_n
         prev = np.zeros((W,), np.int32)
         pos = np.full((W,), -1, np.int32)
         tables = np.zeros((W, self.table_blocks), np.int32)
         gen_idx = np.zeros((W,), np.int32)
         keys = [self._pad_key] * W
-        active = []
-        for i, req in enumerate(self._slots):
-            if req is None:
-                continue
-            active.append(req)
+        for req in reqs:
+            i = req.slot
             prev[i] = req.tokens[-1]
             # prev = generated token gen_idx-1 at global position
             # prompt_len + gen_idx - 1; feeding it samples token gen_idx
@@ -435,21 +823,64 @@ class ContinuousScheduler:
             tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
             gen_idx[i] = req.gen_idx
             keys[i] = req.row_key
+        return prev, pos, tables, gen_idx, keys
+
+    def _poison_shim(self, reqs: List[_PagedRequest]) -> None:
+        """Injected per-request dispatch failure (``serve_raise``).  The
+        message deliberately names no slot: attribution is the
+        supervisor's bisect's job."""
+        for req in reqs:
+            if req.poison == "raise":
+                raise fault.FaultInjectionError(
+                    f"injected decode-dispatch failure (tick {self._tick_no})"
+                )
+
+    def _decode_step(self) -> None:
+        """One single-token step for every occupied slot."""
+        t0 = time.perf_counter()
+        active = [req for req in self._slots if req is not None]
+        self._poison_shim(active)
+        prev, pos, tables, gen_idx, keys = self._decode_arrays(active)
         n_active = len(active)
-        tok, self._pool = self._fns.decode_step(
+        tok, finite, self._pool = self._fns.decode_step(
             self.params, self._pool, prev, pos, tables,
             jnp.stack(keys), gen_idx,
         )
         tok = np.asarray(tok)
+        finite = np.asarray(finite)
         t1 = time.perf_counter()
         for req in active:
+            if not finite[req.slot]:
+                # on-device output guard: evict the NaN emitter, every
+                # other row's logits are untouched (disjoint block tables)
+                self._evict_poisoned(
+                    req, cause=None, trigger="non-finite decode logits"
+                )
+                continue
             self._push_token(req, int(tok[req.slot]))
         self.metrics.record_decode(n_tokens=n_active, decode_s=t1 - t0)
         self.metrics.record_iteration(
-            active_slots=n_active, total_slots=W,
+            active_slots=n_active, total_slots=self.slots_n,
             blocks_in_use=self._kv.blocks_in_use,
             total_blocks=self._kv.num_blocks,
         )
+
+    def _decode_probe(self, reqs: List[_PagedRequest]) -> None:
+        """Re-drive the decode dispatch for a SUBSET of the active slots —
+        the supervisor's bisect primitive.  Inputs are identical to the
+        failed step's, so the pool scatter is idempotent and sampling is
+        pure: probing commits nothing the real step would not."""
+        self._poison_shim(reqs)
+        prev, pos, tables, gen_idx, keys = self._decode_arrays(reqs)
+        tok, _, self._pool = self._fns.decode_step(
+            self.params, self._pool, prev, pos, tables,
+            jnp.stack(keys), gen_idx,
+        )
+        # surface async dispatch errors here, inside the probe's try
+        jax.block_until_ready(tok)
+
+    # ------------------------------------------------------------------ #
+    # retirement and recovery
 
     def _push_token(self, req: _PagedRequest, tok: int) -> None:
         req.tokens.append(tok)
@@ -482,6 +913,26 @@ class ContinuousScheduler:
             self._bump("prefix_evictions", self._kv.prefix_evictions)
             self._kv.prefix_evictions = 0
 
+    def _evict_poisoned(
+        self, req: _PagedRequest, *, cause: Optional[BaseException],
+        trigger: str,
+    ) -> None:
+        """Fail ONE request with a diagnosed :class:`PoisonedRequestError`
+        and free its reservation; every other slot keeps decoding."""
+        err = PoisonedRequestError(
+            f"request in slot {req.slot} poisoned the engine at tick "
+            f"{self._tick_no} ({trigger}) after {req.gen_idx} generated "
+            "tokens"
+        )
+        err.__cause__ = cause
+        self._slots[req.slot] = None
+        self._kv.release(req.admission)
+        req.admission = None
+        if not req.future.done():
+            req.future.set_exception(err)
+        self._bump("requests_poisoned")
+        self.logger.error("%s", err)
+
     def _fail_inflight(self, exc: BaseException) -> None:
         """A device error poisons every in-flight request (their pool
         state is unknown); queued requests are failed too rather than
@@ -497,6 +948,57 @@ class ContinuousScheduler:
                 req.admission = None
             if not req.future.done():
                 req.future.set_exception(exc)
+        if doomed:
+            self._bump("failed_inflight", len(doomed))
+
+    def _rebuild_and_requeue(self) -> None:
+        """Hot-restart: rebuild the compiled programs and the pool, then
+        push every in-flight request back onto the queue head (FCFS order
+        preserved) for replay admission.  Queued requests ride along
+        untouched.  Runs on the scheduler thread (inside tick's except)."""
+        with self._cond:
+            inflight = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.slots_n
+            for req in reversed(inflight):
+                # the reservation indexes the DEAD pool: drop it without
+                # release — allocator and prefix cache are rebuilt below
+                req.admission = None
+                req.slot = -1
+                self._queue.appendleft(req)
+        self._fns = build_paged_fns(
+            self._model, self._block_size, self._num_blocks,
+            temperature=self._temperature,
+        )
+        self._kv = PagedKVPool(
+            self._num_blocks, self._block_size, self._prefix_cache
+        )
+        self._pool = self._fns.init_pool(self.params)
+        if self._pool_sharding is not None:
+            self._pool = jax.device_put(self._pool, self._pool_sharding)
+        if self._watchdog is not None:
+            # the rebuilt programs recompile on first use — re-enter
+            # warmup or the compile stall reads as another hang
+            self._watchdog.reset()
+
+    def _on_tick_hang(self, step: int, elapsed: float, limit: float) -> None:
+        # runs on the watchdog monitor thread: record the diagnosis; the
+        # scheduler thread raises HungTickError when the tick returns
+        with self._cond:
+            self._hang_info = (int(step), float(elapsed), float(limit))
+        self._bump("serve_watchdog_fires")
+
+    # ------------------------------------------------------------------ #
+
+    def _next_wakeup_locked(self) -> float:
+        """Sleep bound while head-of-line blocked: wake for the nearest
+        queued (or drain) deadline, else poll the pool at 50 ms."""
+        now = time.monotonic()
+        deadlines = [r.deadline for r in self._queue if r.deadline is not None]
+        if self._draining and self._drain_deadline is not None:
+            deadlines.append(self._drain_deadline)
+        if not deadlines:
+            return 0.05
+        return min(0.05, max(min(deadlines) - now, 0.001))
 
     def _loop(self) -> None:
         while True:
@@ -514,7 +1016,18 @@ class ContinuousScheduler:
                 ):
                     return
             try:
-                self.tick()
-            except BaseException as exc:  # keep the loop alive
-                self.logger.exception("scheduler tick failed")
+                did = self.tick()
+            except BaseException as exc:  # supervisor itself failed
+                self.logger.exception("scheduler tick failed beyond recovery")
                 self._fail_inflight(exc)
+                did = True
+            with self._cond:
+                self._cond.notify_all()  # drain()/close() watchers
+                if not did and not self._closed and self._queue:
+                    # head-of-line blocked on pool admission with nothing
+                    # decoding: sleep until a deadline can expire or the
+                    # state changes instead of spinning on admit attempts
+                    # (this is also what guarantees an admission-waiting
+                    # request is swept AT its deadline, not at the next
+                    # submit)
+                    self._cond.wait(timeout=self._next_wakeup_locked())

@@ -85,7 +85,9 @@ class Retry:
                     # retrying cannot help, surface it immediately
                     raise
                 if attempt == self.attempts - 1:
+                    self._count("retry_exhausted")
                     raise
+                self._count("retry_attempts")
                 d = self.delay(attempt)
                 if on_retry is not None:
                     on_retry(attempt, exc, d)
@@ -96,6 +98,18 @@ class Retry:
                         attempt + 1, self.attempts, exc, d,
                     )
                 self._sleep(d)
+
+    @staticmethod
+    def _count(name: str) -> None:
+        """Mirror retry traffic into the process telemetry registry.
+
+        ``retry_attempts`` counts retried failures (not first tries);
+        ``retry_exhausted`` counts budget exhaustions.  Lazy import keeps
+        this module importable standalone, matching engine/fault.py.
+        """
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter(name).inc()
 
     def __call__(self, fn: Callable) -> Callable:
         """Decorator form: ``@Retry(...)`` wraps ``fn`` with ``call``."""

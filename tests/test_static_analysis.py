@@ -431,3 +431,40 @@ def test_framework_registers_all_five_passes():
         "donation-safety",
         "marker-convention",
     }
+
+
+# --------------------------------------------- serving fault-tolerance gate
+
+
+def test_cli_clean_on_serving_modules():
+    """PR 9 gate: the serving tree (scheduler + resilience + kv pool +
+    engine) passes every analysis pass — in particular lock-discipline
+    over the supervisor's cross-thread restart counters and the
+    scheduler's cond-guarded queue/drain/hang state."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytorch_distributed_training_tpu.analysis",
+            "--root",
+            str(PKG / "serving"),
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_serving_recovery_state_is_lock_annotated():
+    """The cross-thread recovery state must stay VISIBLY guarded: the
+    lock-discipline pass keys off ``# guarded by:`` annotations, so
+    silently dropping them would also silently drop its coverage of the
+    supervisor and scheduler."""
+    sup = (PKG / "serving" / "resilience.py").read_text()
+    assert sup.count("# guarded by: self._lock") >= 2  # _restarts, _exhausted
+    sched = (PKG / "serving" / "scheduler.py").read_text()
+    # queue/close/drain/hang state all ride the scheduler condition
+    assert sched.count("# guarded by: self._cond") >= 5
