@@ -46,6 +46,7 @@ BENCH_DRIVERS = (
     "bench_chaos(",
     "bench_serve(",
     "bench_chaos_serve(",
+    "bench_chaos_integrity(",
 )
 
 FAULT_MACHINERY = (
@@ -55,7 +56,9 @@ FAULT_MACHINERY = (
     "StepWatchdog",
     "ProcessLoaderPool",
     "ElasticCoordinator",
+    "IntegritySentinel",
     "kill_peer",
+    "sdc_flip",
     "multihost_worker",
     "MH_ELASTIC",
 )

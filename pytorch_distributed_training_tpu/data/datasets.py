@@ -324,6 +324,10 @@ class ImageFolderDataset:
         # the other's dims writes (and the reader's view of them)
         self._dims_cache: Optional[np.ndarray] = None
         self._dims_lock = threading.Lock()
+        # corrupt-sample quarantine: paths already logged (log once per
+        # path; the counter still bumps per occurrence)
+        self._corrupt_logged: set = set()  # guarded by: self._corrupt_lock
+        self._corrupt_lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self.samples)
@@ -333,11 +337,14 @@ class ImageFolderDataset:
         state = self.__dict__.copy()
         state["_dims_lock"] = None
         state["_dims_cache"] = None
+        state["_corrupt_lock"] = None
+        state["_corrupt_logged"] = set()
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._dims_lock = threading.Lock()
+        self._corrupt_lock = threading.Lock()
 
     # Per-channel normalization applied at batch-assembly time by the
     # loader's fused native kernel (see data/loader.py + native/).
@@ -369,8 +376,14 @@ class ImageFolderDataset:
             return int(w), int(h)
         from PIL import Image
 
-        with Image.open(self.samples[idx][0]) as im:
-            dims = im.size
+        try:
+            with Image.open(self.samples[idx][0]) as im:
+                dims = im.size
+        except (OSError, ValueError, SyntaxError):
+            # unreadable header: dummy dims keep the batch's serial
+            # crop-sampling pass alive — the decode stage then fails this
+            # row too and _quarantine feeds zeros for it
+            dims = (self.image_size, self.image_size)
         self._dims_cache[idx] = dims
         return dims
 
@@ -398,28 +411,61 @@ class ImageFolderDataset:
         # native batch-assembly pass (one pass, no per-image temporaries)
         return np.asarray(im, dtype=np.uint8)
 
+    def _quarantine(self, idx: int, exc: Exception) -> np.ndarray:
+        """A sample whose image fails to decode is quarantined — zero
+        pixels under its true label — instead of raising out of the loader
+        backend: a raise in a pool worker kills the worker and burns a
+        respawn from the fault-tolerance budget on a PERMANENT input
+        problem no respawn can fix.  Every occurrence bumps the
+        ``data_corrupt_samples`` counter; the path is logged once."""
+        import logging
+
+        from ..telemetry.registry import get_registry
+
+        get_registry().counter("data_corrupt_samples").inc()
+        path = self.samples[idx][0]
+        with self._corrupt_lock:
+            first = path not in self._corrupt_logged
+            self._corrupt_logged.add(path)
+        if first:
+            logging.getLogger(__name__).warning(
+                "quarantined corrupt sample %s (%s: %s) — feeding zero "
+                "pixels with its label; fix or remove the file",
+                path, type(exc).__name__, exc,
+            )
+        return np.zeros((self.image_size, self.image_size, 3), np.uint8)
+
     def decode_with_params(self, idx: int, params) -> np.ndarray:
         """PIL pixel path for an already-sampled crop box + flip flag.
 
         Used directly by the loader when the native kernel reports a row it
         cannot decode (non-JPEG, CMYK) — the *same* params the native path
-        would have used, so fallback rows stay bit-reproducible.
+        would have used, so fallback rows stay bit-reproducible.  A row
+        that PIL cannot decode either (truncated/corrupt file) is
+        quarantined, not raised.
         """
         from PIL import Image
 
-        with Image.open(self.samples[idx][0]) as im:
-            return self._pil_pixels(im, params)
+        try:
+            with Image.open(self.samples[idx][0]) as im:
+                return self._pil_pixels(im, params)
+        except (OSError, ValueError, SyntaxError) as e:
+            return self._quarantine(idx, e)
 
     def get_sample(self, idx: int, rng: Optional[np.random.Generator]) -> Tuple[np.ndarray, np.int64]:
         """PIL reference path: one open — header dims, param sampling, then
-        decode + one-shot box resize (+flip)."""
+        decode + one-shot box resize (+flip).  Corrupt images quarantine
+        (zeros + true label) instead of raising — see :meth:`_quarantine`."""
         from PIL import Image
 
         path, label = self.samples[idx]
-        with Image.open(path) as im:
-            w, h = im.size
-            params = sample_crop_params(w, h, rng, self.train, size=self.image_size)
-            return self._pil_pixels(im, params), np.int64(label)
+        try:
+            with Image.open(path) as im:
+                w, h = im.size
+                params = sample_crop_params(w, h, rng, self.train, size=self.image_size)
+                return self._pil_pixels(im, params), np.int64(label)
+        except (OSError, ValueError, SyntaxError) as e:
+            return self._quarantine(idx, e), np.int64(label)
 
     def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.int64]:
         # Index-seeded fallback (epoch-0 stream); loaders use fetch_sample /

@@ -4,6 +4,7 @@ Replaces the reference's L4/L6 layers (``Runner`` process orchestration and
 the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
 from .elastic import ElasticCoordinator, PeerLostError
+from .integrity import DivergedReplicaError, IntegritySentinel
 from .profiling import TraceProfiler
 from .runner import Runner
 from .sp_steps import build_lm_eval_step, build_lm_train_step
@@ -17,7 +18,9 @@ from .steps import (
 from .tp_steps import build_tp_lm_train_step
 
 __all__ = [
+    "DivergedReplicaError",
     "ElasticCoordinator",
+    "IntegritySentinel",
     "PeerLostError",
     "Runner",
     "TraceProfiler",

@@ -77,7 +77,12 @@ import jax
 from ..telemetry.registry import get_registry
 from ..telemetry.spans import span
 
-__all__ = ["AsyncCheckpointError", "Checkpointer", "load_serving_state"]
+__all__ = [
+    "AsyncCheckpointError",
+    "Checkpointer",
+    "CheckpointIntegrityError",
+    "load_serving_state",
+]
 
 # The layout-vs-corruption discrimination in ``_structure_differs`` relies
 # on an orbax contract that is conventional, not documented API: that
@@ -128,6 +133,14 @@ class AsyncCheckpointError(RuntimeError):
     write — the deferred-error contract of async checkpointing.  The
     original storage error is chained as ``__cause__``.
     """
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A restored checkpoint is well-formed but fails content integrity:
+    its per-leaf CRC manifest mismatches the restored bytes, its manifest
+    advertises a different step, or its pipeline sidecar does.  The
+    restore-latest loop treats it exactly like a truncated checkpoint —
+    fall back to the newest *verified* earlier step."""
 
 
 class _Pending:
@@ -234,6 +247,7 @@ class Checkpointer:
         self._deferred: List[Tuple[int, BaseException]] = []
         self._known_steps: set = set()  # committed steps (sidecar GC diff)
         self._async_fallback_warned = False
+        self._warned_no_manifest = False  # pre-manifest checkpoints: warn once
         self._manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -325,13 +339,15 @@ class Checkpointer:
 
         from . import fault
 
+        state, manifest = self._manifest_and_corrupt(it, state)
+
         def _save():
             fault.get_injector().check_fail_point("ckpt_save")
             self._manager.save(it, args=ocp.args.StandardSave(state))
             self._manager.wait_until_finished()
 
         self.retry.call(_save, on_retry=self._count_retry)
-        self._after_commit(it, extras)
+        self._after_commit(it, extras, manifest)
 
     # ------------------------------------------------------- async save path
     def _save_async(self, it: int, state, extras: Optional[dict]) -> None:
@@ -390,6 +406,8 @@ class Checkpointer:
 
         from . import fault
 
+        snapshot, manifest = self._manifest_and_corrupt(it, snapshot)
+
         def _write():
             fault.get_injector().check_fail_point("ckpt_async_write")
             self._manager.save(it, args=ocp.args.StandardSave(snapshot))
@@ -399,7 +417,7 @@ class Checkpointer:
         # trace shows the write overlapping the steps that hid it
         with span("ckpt_async_write", step=it):
             self.retry.call(_write, on_retry=self._count_retry)
-        self._after_commit(it, extras)
+        self._after_commit(it, extras, manifest)
         fault.bump("ckpt_async_commits")
 
     def _join_oldest(self, timeout: Optional[float] = None) -> bool:
@@ -467,10 +485,28 @@ class Checkpointer:
             self._deferred.clear()
         return drained
 
-    def _after_commit(self, it: int, extras: Optional[dict]) -> None:
+    def _manifest_and_corrupt(self, it: int, payload):
+        """Per-leaf CRC manifest of the save payload, plus the
+        ``ckpt_corrupt`` injection — the bit flip is applied to a COPY and
+        strictly AFTER the manifest, so the corrupted checkpoint commits
+        and restores cleanly through orbax; only the manifest verification
+        at restore time can catch it (the scenario under test)."""
+        from . import fault
+        from .integrity import _flip_one_bit, leaf_checksums
+
+        manifest = leaf_checksums(payload)
+        if fault.get_injector().take("ckpt_corrupt", it) is not None:
+            payload = _flip_one_bit(payload, logging.getLogger(__name__))
+            fault.bump("injected_ckpt_corruptions")
+        return payload, manifest
+
+    def _after_commit(self, it: int, extras: Optional[dict],
+                      manifest: Optional[dict] = None) -> None:
         """Post-commit effects, strictly AFTER the checkpoint is durable:
-        the sidecar (which must never advertise a step that doesn't exist)
-        and sidecar GC."""
+        the integrity manifest, the sidecar (which must never advertise a
+        step that doesn't exist), and GC of both."""
+        if jax.process_index() == 0 and manifest is not None:
+            self._write_manifest(it, manifest)
         if extras is not None and jax.process_index() == 0:
             self._write_extras(it, dict(extras))
         self._known_steps.add(it)
@@ -484,10 +520,12 @@ class Checkpointer:
             self._known_steps &= kept
             if jax.process_index() == 0:
                 for step in removed:
-                    try:
-                        os.remove(self._extras_path(step))
-                    except OSError:
-                        pass
+                    for path in (self._extras_path(step),
+                                 self._manifest_path(step)):
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
 
     # ------------------------------------------------ pipeline-state sidecar
     def _extras_path(self, step: int) -> str:
@@ -497,11 +535,30 @@ class Checkpointer:
         """Atomically write the input-pipeline sidecar for ``step`` (an
         orphan sidecar is harmless — its step is never restored — and a
         missing one degrades to the pre-sidecar resume, so pruning is
-        deferred to GC events in ``_after_commit``)."""
+        deferred to GC events in ``_after_commit``).  A ``step`` key is
+        merged in FLAT (the extras dict never carries one — it is stripped
+        on read) so the restore can cross-check a sidecar that was
+        renamed/mispaired against the checkpoint it sits next to
+        (``_verify_restored``) while pre-step sidecars and direct readers
+        keep the same shape."""
         tmp = self._extras_path(step) + f".tmp{os.getpid()}"
         with open(tmp, "w") as fp:
-            json.dump(extras, fp)
+            json.dump({**(extras or {}), "step": int(step)}, fp)
         os.replace(tmp, self._extras_path(step))
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"manifest_{step}.json")
+
+    def _write_manifest(self, step: int, manifest: dict) -> None:
+        """Atomically write the per-leaf CRC manifest for ``step``
+        (engine/integrity.py:leaf_checksums; verified on restore)."""
+        tmp = self._manifest_path(step) + f".tmp{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(
+                {"step": int(step), "algo": "crc32-leaf", "leaves": manifest},
+                fp,
+            )
+        os.replace(tmp, self._manifest_path(step))
 
     def read_extras(self, step: int) -> Optional[dict]:
         """The sidecar saved alongside checkpoint ``step`` (periodic sidecar
@@ -522,7 +579,11 @@ class Checkpointer:
                     payload = json.load(fp)
             except (OSError, ValueError):
                 continue
-            return payload.get("extras", payload)
+            if isinstance(payload, dict) and "extras" in payload:
+                return payload["extras"]  # emergency meta wraps extras
+            if isinstance(payload, dict):
+                payload.pop("step", None)  # the cross-check key, not extras
+            return payload
         return None
 
     # --------------------------------------------------- emergency (elastic)
@@ -824,9 +885,96 @@ class Checkpointer:
                 "/ pp_unstack_params before resuming, or resume with the "
                 f"original setting. Underlying error: {e}"
             ) from e
+        self._verify_restored(step, restored, logger)
         if logger:
             logger.info("Restored checkpoint at iter %d from %s", step, self.directory)
         return restored, step + 1
+
+    def _verify_restored(
+        self, step: int, restored, logger: Optional[logging.Logger] = None
+    ) -> None:
+        """Content-integrity gate for a structurally successful restore.
+
+        Recomputes the per-leaf CRCs and compares them against the step's
+        manifest; also cross-checks the step the manifest and the pipeline
+        sidecar each claim to belong to.  Any mismatch raises
+        :class:`CheckpointIntegrityError` so ``restore_latest`` falls back
+        to an earlier step — a corrupt-but-well-formed checkpoint must
+        lose to the newest *verified* one.  A MISSING manifest is the
+        pre-manifest format: restore proceeds with a single warning
+        (backward compatibility), never a rejection.
+        """
+        from . import fault
+        from .integrity import leaf_checksums
+
+        log = logger or logging.getLogger(__name__)
+        mpath = self._manifest_path(step)
+        if not os.path.exists(mpath):
+            if not self._warned_no_manifest:
+                self._warned_no_manifest = True
+                log.warning(
+                    "checkpoint step %d at %s has no integrity manifest "
+                    "(saved before manifests existed) — restored without "
+                    "content verification", step, self.directory,
+                )
+        else:
+            try:
+                with open(mpath) as fp:
+                    manifest = json.load(fp)
+                want = {k: int(v) for k, v in manifest.get("leaves", {}).items()}
+                claimed = manifest.get("step")
+            except (OSError, ValueError) as e:
+                fault.bump("integrity_manifest_rejects")
+                raise CheckpointIntegrityError(
+                    f"integrity manifest for checkpoint step {step} is "
+                    f"unreadable ({type(e).__name__}: {e}) — treating the "
+                    "step as a corrupt candidate"
+                ) from e
+            if claimed is not None and int(claimed) != int(step):
+                fault.bump("integrity_manifest_rejects")
+                raise CheckpointIntegrityError(
+                    f"integrity manifest next to checkpoint step {step} "
+                    f"claims step {claimed} — mispaired or tampered; "
+                    "treating the step as a corrupt candidate"
+                )
+            got = leaf_checksums(restored)
+            if set(want) != set(got):
+                # a layout-converted restore legitimately reshapes the
+                # tree; CRCs of different leaves can't be compared
+                log.warning(
+                    "integrity manifest for step %d covers a different "
+                    "leaf set than the restored tree (layout conversion?) "
+                    "— content verification skipped", step,
+                )
+            else:
+                bad = sorted(k for k in want if want[k] != got[k])
+                if bad:
+                    fault.bump("integrity_manifest_rejects")
+                    raise CheckpointIntegrityError(
+                        f"checkpoint step {step} failed CRC verification on "
+                        f"{len(bad)} of {len(want)} leaves (first: {bad[0]}) "
+                        "— content corruption; falling back to the newest "
+                        "verified earlier step"
+                    )
+        spath = self._extras_path(step)
+        if os.path.exists(spath):
+            try:
+                with open(spath) as fp:
+                    payload = json.load(fp)
+            except (OSError, ValueError):
+                # an unreadable sidecar already degrades gracefully in
+                # read_extras (position derived from the step counter)
+                payload = None
+            if (
+                isinstance(payload, dict) and "step" in payload
+                and int(payload["step"]) != int(step)
+            ):
+                fault.bump("integrity_sidecar_rejects")
+                raise CheckpointIntegrityError(
+                    f"pipeline sidecar next to checkpoint step {step} "
+                    f"claims step {payload['step']} — mispaired sidecar; "
+                    "treating the step as a corrupt candidate"
+                )
 
     @staticmethod
     def _path_keys(tree) -> set:

@@ -57,6 +57,13 @@ class PeerLostError(RuntimeError):
         are unrecoverable, so the emergency checkpoint path must not touch
         the current state (the last periodic checkpoint is the resume
         point instead).
+
+    Subclasses extend the same contract to losses that are POLICY rather
+    than silence: :class:`~.integrity.DivergedReplicaError` quarantines a
+    persistently corrupt replica by exiting with the diagnosis — peers
+    then observe that exit through this heartbeat layer as an ordinary
+    peer loss and the relaunch reshapes around it, so the reshaped-resume
+    machinery needs no corruption-specific branch.
     """
 
     def __init__(self, message: str, dead_ranks=(), mid_step: bool = False):

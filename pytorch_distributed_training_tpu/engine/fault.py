@@ -23,6 +23,18 @@ Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
                        detect the silence via the elastic heartbeat layer
                        (engine/elastic.py) instead of hanging in the next
                        collective
+    sdc_flip@K[:R]     silently flip one parameter bit on replica R
+                       (default 0; -1 = whichever rank parses it) at step K
+                       — no raise, no NaN: the integrity sentinel
+                       (engine/integrity.py) must detect the divergence at
+                       its next fingerprint vote, attribute it to rank R,
+                       and restore the healthy-majority state
+    ckpt_corrupt@K     flip one bit in the payload of the checkpoint SAVED
+                       at step K (after its checksum manifest is computed)
+                       — the save commits cleanly and orbax restores it
+                       without error; only the manifest verification at
+                       restore time can reject it in favor of the newest
+                       verified earlier step
     ckpt_fail@A[:N]    fail checkpoint-save attempts A..A+N-1 (0-based
                        attempt ordinal across the process; the retry policy
                        must absorb them)
@@ -54,10 +66,10 @@ tick):
                        watchdog must fire and convert the stall into a
                        diagnosed restart
 
-Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/the
-``serve_*`` family) are one-shot: consumed when they fire, so a rollback
-replay of the same step index does not re-trip them (the recovery itself
-must converge).
+Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/
+``sdc_flip``/``ckpt_corrupt``/the ``serve_*`` family) are one-shot:
+consumed when they fire, so a rollback replay of the same step index does
+not re-trip them (the recovery itself must converge).
 
 This module is import-light on purpose (stdlib only): the data pipeline and
 serving stack consult it without pulling the JAX engine in.  The recovery
@@ -92,6 +104,7 @@ ENV_VAR = "PDT_FAULT_SPEC"
 
 _STEP_KINDS = (
     "nan_batch", "kill_worker", "stall_step", "kill_peer",
+    "sdc_flip", "ckpt_corrupt",
     "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
 )
 _POINT_KINDS = {
@@ -157,15 +170,16 @@ class FaultInjector:
                 )
             self._fail_windows.setdefault(_POINT_KINDS[kind], []).append((step, n))
         elif kind in _STEP_KINDS:
-            if kind in ("kill_worker", "serve_nan", "serve_raise"):
-                # arg = worker index / scheduler slot index (default 0)
+            if kind in ("kill_worker", "serve_nan", "serve_raise", "sdc_flip"):
+                # arg = worker index / scheduler slot index / replica rank
+                # (default 0)
                 val = float(int(arg)) if arg is not None else 0.0
             elif kind == "kill_peer":
                 # arg = target process index; -1 = whichever rank parses it
                 val = float(int(arg)) if arg is not None else -1.0
             elif kind in ("stall_step", "serve_hang"):
                 val = float(arg) if arg is not None else 1.0
-            else:  # nan_batch / serve_device_lost take no arg
+            else:  # nan_batch / serve_device_lost / ckpt_corrupt take no arg
                 if arg is not None:
                     raise ValueError(
                         f"bad {ENV_VAR} entry {entry!r}: {kind} takes no arg"

@@ -61,6 +61,7 @@ from ..telemetry import Telemetry
 from . import fault
 from .checkpoint import Checkpointer
 from .elastic import ElasticCoordinator, PeerLostError
+from .integrity import DivergedReplicaError, IntegritySentinel
 from .paths import select_path
 from .profiling import TraceProfiler
 from .steps import TrainState
@@ -68,6 +69,7 @@ from .topology import (
     parse_batch,
     parse_elastic,
     parse_fault_tolerance,
+    parse_integrity,
     parse_telemetry,
     parse_topology,
 )
@@ -190,6 +192,10 @@ class Runner:
         # files only when dir is set — telemetry/ package, README
         # "Observability").
         parse_telemetry(self, train_cfg)
+        # Integrity-sentinel keys (additive, off by default): periodic
+        # state-fingerprint votes + quarantine (engine/integrity.py,
+        # README "Integrity").
+        parse_integrity(self, train_cfg)
         if self.fault_spec and not os.environ.get(fault.ENV_VAR):
             fault.install(self.fault_spec)
         self._injector = fault.get_injector()
@@ -349,6 +355,32 @@ class Runner:
         # restarts the stream on exactly the next unseen batch.
         self._init_pipeline_position()
 
+        # --- integrity sentinel (engine/integrity.py; config-gated) ---------
+        # Fingerprint votes between steps + a retained known-good snapshot;
+        # seeded with the state we are about to train from (post-restore),
+        # so even the first check has a recovery point to replay from.
+        self._integrity = None
+        if self.integrity_enabled:
+            self._integrity = IntegritySentinel(
+                check_interval=self.integrity_check_interval,
+                replicas=self.integrity_replicas,
+                rank=self.current_rank,
+                process_count=jax.process_count(),
+                max_consecutive=self.integrity_max_consecutive,
+                logger=self.logger,
+            )
+            self._integrity.retain(
+                self.state, self.iter - 1, self._pipeline_extras()
+            )
+            self.logger.info(
+                "integrity sentinel ON: fingerprint vote every %d step(s) "
+                "across %d replica(s)%s, quarantine after %d consecutive "
+                "diverged check(s)",
+                self._integrity.check_interval, self._integrity.replicas,
+                " (simulated)" if self._integrity.simulated else "",
+                self._integrity.max_consecutive,
+            )
+
         # --- elastic heartbeat coordinator (engine/elastic.py; config-gated) -
         self._elastic = None
         if self.elastic_enabled:
@@ -459,6 +491,14 @@ class Runner:
         try:
             with self._preempt if self._preempt else contextlib.nullcontext():
                 self._train_loop(iter_generator, train_cfg)
+        except DivergedReplicaError as e:
+            # persistent silent corruption: quarantine — a healthy rank
+            # emergency-checkpoints, the corrupt one just exits with the
+            # diagnosis; the relaunch reshapes without it (the subclass
+            # relationship with PeerLostError is the contract: peers see
+            # this process's exit as an ordinary peer loss)
+            self._on_diverged(e)
+            raise
         except PeerLostError as e:
             # diagnosed dead peer: emergency-checkpoint what this process can
             # still save, then propagate — the caller relaunches at the new
@@ -675,6 +715,21 @@ class Runner:
                 "fault injection: stalling step %d for %.2fs", self.iter, s
             )
             time.sleep(float(s))
+        f = inj.take("sdc_flip", self.iter)
+        if f is not None:
+            if self._integrity is None:
+                self.logger.warning(
+                    "fault injection: sdc_flip@%d ignored — the integrity "
+                    "sentinel is not configured (training.integrity)",
+                    self.iter,
+                )
+            else:
+                self.logger.warning(
+                    "fault injection: arming silent bit flip on replica %d "
+                    "at step %d — the sentinel's next fingerprint vote must "
+                    "attribute it", int(f), self.iter,
+                )
+                self._integrity.arm_flip(int(f))
 
     def _on_hang(self, step: int, elapsed: float, limit: float) -> None:
         """Watchdog diagnostic dump (monitor thread): step identity,
@@ -834,6 +889,96 @@ class Runner:
         self._gnorm_hist.clear()
         return self._make_stream()
 
+    def _integrity_recover(self, iter_generator, verdict):
+        """This replica's fingerprint fell outside the healthy majority:
+        restore the retained known-good snapshot in place and replay from
+        it.  A transient flip heals here — the replayed steps recompute
+        bit-identically (deterministic input stream, one-shot faults
+        consumed) and the next check passes, resetting the consecutive
+        count.  A flip that survives the restore (the snapshot's
+        fingerprint does not reproduce) is persistent by definition —
+        escalate to quarantine instead of looping restore→diverge."""
+        sen = self._integrity
+        self.logger.error(
+            "integrity: replica %d diverged at step %d (reports %s) — "
+            "restoring the retained snapshot of step %s and replaying",
+            self.current_rank, self.iter,
+            [f"{r:08x}" for r in verdict["reports"]], sen.snapshot_step,
+        )
+        try:
+            iter_generator.close()
+        except Exception:  # pragma: no cover - abandoned stream cleanup
+            pass
+        restored, snap_step, position, ok = sen.restore_snapshot(self.state)
+        if not ok:
+            raise DivergedReplicaError(
+                f"replica {self.current_rank}'s state diverged at step "
+                f"{self.iter} and restoring the retained snapshot of step "
+                f"{snap_step} did not reproduce its fingerprint — the "
+                "corruption is persistent (bad host/device memory), "
+                "quarantining",
+                ranks=(self.current_rank,), step=self.iter,
+            )
+        self.state = restored
+        fault.bump("integrity_transient_flips")
+        self.iter = snap_step + 1
+        self.scheduler.last_epoch = self.iter
+        if position is not None:
+            self._epoch = int(position["epoch"])
+            self._batch_in_epoch = int(position["batch_in_epoch"])
+        else:
+            self._epoch, self._batch_in_epoch = divmod(
+                self.iter, self._batches_per_epoch
+            )
+        return self._make_stream()
+
+    def _on_diverged(self, e: DivergedReplicaError):
+        """Persistent corruption diagnosed: log, count, and emergency-
+        checkpoint — but ONLY when this replica is healthy (a quarantined
+        rank must never persist its corrupted state; peers save theirs,
+        and the heartbeat layer turns this process's exit into an ordinary
+        peer loss the relaunch reshapes around)."""
+        fault.bump("integrity_quarantines")
+        self.logger.error("integrity quarantine: %s", e)
+        tel = self._telemetry
+        if tel is not None and tel.enabled:
+            try:
+                self.logger.error(
+                    "quarantine telemetry diagnostics:\n%s", tel.diagnostics()
+                )
+            except Exception:  # pragma: no cover - best-effort diagnostics
+                pass
+        if self.current_rank in e.ranks:
+            self.logger.error(
+                "local replica %d is the quarantined one — skipping the "
+                "emergency checkpoint (corrupted state must not be saved); "
+                "a healthy rank's emergency step or the last verified "
+                "periodic checkpoint carries the resume", self.current_rank,
+            )
+            return
+        if self.checkpointer is None:
+            self.logger.error(
+                "no checkpointer configured — the relaunch starts from "
+                "the last durable checkpoint, if any"
+            )
+            return
+        try:
+            path = self.checkpointer.save_emergency(
+                self.iter, self.state, extras=self._pipeline_extras()
+            )
+            self.logger.error(
+                "EMERGENCY checkpoint for step %d written to %s by healthy "
+                "rank %d — the relaunch resumes from it without the "
+                "quarantined replica(s) %s",
+                self.iter, path, self.current_rank, list(e.ranks),
+            )
+        except ValueError as ve:
+            # non-replicated state: a single survivor only holds one shard
+            self.logger.error(
+                "emergency checkpoint skipped: %s — the relaunch resumes "
+                "from the last durable checkpoint", ve,
+            )
+
     def _train_loop(self, iter_generator, train_cfg):
         tel = self._telemetry
         # goodput accounting: a step at an iteration index we already passed
@@ -884,6 +1029,39 @@ class Runner:
                 iter_generator = self._rollback(iter_generator, train_cfg)
                 tel.note_lost("rollback", time.monotonic() - rb_t0)
                 continue
+            if self._integrity is not None and self._integrity.due(self.iter):
+                # between steps the state is quiescent and owned (no
+                # donation conflict with the compiled step) — fingerprint,
+                # vote, and either retain a new known-good snapshot or
+                # enter the classify-then-quarantine ladder
+                with tel.span("integrity_check", step=self.iter):
+                    self.state, verdict = self._integrity.check(
+                        self.state, self.iter
+                    )
+                if verdict["persistent"]:
+                    raise DivergedReplicaError(
+                        f"replica(s) {verdict['persistent']} stayed outside "
+                        f"the healthy fingerprint majority for "
+                        f"{self._integrity.max_consecutive} consecutive "
+                        f"checks at step {self.iter} — persistent "
+                        "corruption, quarantining",
+                        ranks=verdict["persistent"], step=self.iter,
+                    )
+                if verdict["local_diverged"]:
+                    rc_t0 = time.monotonic()
+                    iter_generator = self._integrity_recover(
+                        iter_generator, verdict
+                    )
+                    tel.note_lost(
+                        "integrity_restore", time.monotonic() - rc_t0
+                    )
+                    continue
+                # healthy consensus (a diverged SIMULATED peer restores
+                # its own copy; our state is good) — retain it as the
+                # recovery point for the next check
+                self._integrity.retain(
+                    self.state, self.iter, self._pipeline_extras()
+                )
             if self._preempt and self._globally_preempted():
                 self.logger.warning(
                     "Preemption signal received: saving checkpoint at iter "

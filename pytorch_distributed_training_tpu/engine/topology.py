@@ -32,6 +32,7 @@ __all__ = [
     "parse_batch",
     "parse_fault_tolerance",
     "parse_elastic",
+    "parse_integrity",
     "parse_telemetry",
 ]
 
@@ -545,6 +546,56 @@ def parse_elastic(r, train_cfg: dict) -> None:
                 "heartbeat dir defaults to <checkpoint.dir>/heartbeats and "
                 "peer loss triggers a checkpoint-and-exit), or an explicit "
                 "training.elastic.dir"
+            )
+
+
+def parse_integrity(r, train_cfg: dict) -> None:
+    """Parse the additive ``training.integrity`` section (off by default)
+    onto the runner — the silent-data-corruption sentinel
+    (engine/integrity.py):
+
+    .. code-block:: yaml
+
+        training:
+            integrity:
+                enabled: true         # implied by a non-empty section
+                check_interval: 100   # steps between fingerprint votes
+                replicas: null        # voters; null = real process count,
+                                      # > process count simulates peers
+                                      # (the 1-device injection/test path)
+                max_consecutive: 2    # diverged checks before a replica is
+                                      # PERSISTENTLY corrupt (quarantine)
+    """
+    ig = train_cfg.get("integrity") or {}
+    unknown = set(ig) - {
+        "enabled", "check_interval", "replicas", "max_consecutive",
+    }
+    if unknown:
+        raise ValueError(
+            f"training.integrity: unknown key(s) {sorted(unknown)} "
+            "(want enabled/check_interval/replicas/max_consecutive)"
+        )
+    r.integrity_enabled = bool(ig) and bool(ig.get("enabled", True))
+    r.integrity_check_interval = int(ig.get("check_interval", 100))
+    r.integrity_replicas = (
+        int(ig["replicas"]) if ig.get("replicas") is not None else None
+    )
+    r.integrity_max_consecutive = int(ig.get("max_consecutive", 2))
+    if r.integrity_enabled:
+        if r.integrity_check_interval < 1:
+            raise ValueError(
+                "training.integrity.check_interval must be >= 1, got "
+                f"{r.integrity_check_interval}"
+            )
+        if r.integrity_replicas is not None and r.integrity_replicas < 1:
+            raise ValueError(
+                "training.integrity.replicas must be >= 1, got "
+                f"{r.integrity_replicas}"
+            )
+        if r.integrity_max_consecutive < 1:
+            raise ValueError(
+                "training.integrity.max_consecutive must be >= 1, got "
+                f"{r.integrity_max_consecutive}"
             )
 
 

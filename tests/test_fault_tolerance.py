@@ -223,6 +223,7 @@ def test_unknown_fault_kind_names_the_valid_kinds():
         FaultInjector("kil_peer@3")
     msg = str(ei.value)
     for kind in ("nan_batch", "kill_worker", "stall_step", "kill_peer",
+                 "sdc_flip", "ckpt_corrupt",
                  "ckpt_fail", "restore_fail", "ckpt_async_fail"):
         assert kind in msg, f"{kind!r} missing from the error menu: {msg}"
 
