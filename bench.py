@@ -26,6 +26,12 @@ Additional modes (VERDICT round-1 item #1 — prove host-side throughput):
                              (training.checkpoint.async) — save-step stall,
                              bytes written, overlap efficiency, plus a
                              kill-during-async-write restore probe.
+  python bench.py overlap  — gradient-reduction A/B: implicit in-loss
+                             reduction vs the bucketed backward-overlapped
+                             schedule (training.comm.overlap) for the
+                             ResNet DP step and the TransformerLM SP step;
+                             reports step-time delta + overlap-efficiency
+                             gauge and the comm_bucket_bytes histogram.
 
 Precision: bf16 compute with fp32 master weights and fp32 BN statistics —
 the TPU-native mixed-precision mode (BASELINE.json config #4); set
@@ -1733,6 +1739,171 @@ def bench_chaos_multihost():
     )
 
 
+def bench_overlap():
+    """A/B: implicit in-loss reduction vs bucketed backward-overlapped
+    reduction (training.comm.overlap, engine/comm.py) — ResNet DP step and
+    TransformerLM SP step, overlap off vs on, same shapes and windows.
+
+    Emits ONE JSON line with per-model step times, the step-time delta, an
+    ``overlap_efficiency`` gauge ((t_off - t_on) / t_off: the fraction of
+    the baseline step the explicit schedule saved; negative = regression),
+    and the ``comm_bucket_bytes`` histogram of the traced bucket plan.
+
+    CPU honesty: on the vanilla CPU image this runs under the
+    PDT_JAX_COMPAT graft, where the pre-vma shard_map transpose drops the
+    baseline's implicit backward all-reduce entirely — the baseline is
+    structurally cheaper than on the real toolchain, so expect a NEGATIVE
+    efficiency here (the explicit collectives + concat/split are pure added
+    work); the number that matters comes from the TPU toolchain where both
+    programs carry their reductions.  Knobs: BENCH_OVERLAP_BUCKET_MB
+    (default 4), BENCH_OVERLAP_DTYPE (null|float32|bfloat16),
+    BENCH_OVERLAP_FAKE_DEVICES (CPU fake-device count, default 8 when
+    JAX_PLATFORMS=cpu), and the usual BENCH_ITERS/BENCH_WINDOWS.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.engine import (
+        TrainState,
+        build_lm_train_step,
+        build_train_step,
+        init_train_state,
+    )
+    from pytorch_distributed_training_tpu.engine.comm import CommConfig
+    from pytorch_distributed_training_tpu.models import get_model
+    from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+    from pytorch_distributed_training_tpu.optimizers import SGD, AdamW
+    from pytorch_distributed_training_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        make_sp_mesh,
+        replicated_sharding,
+    )
+    from pytorch_distributed_training_tpu.schedulers import cosine_lr, multi_step_lr
+    from pytorch_distributed_training_tpu.telemetry import get_registry
+
+    comm = CommConfig(
+        overlap=True,
+        bucket_mb=float(os.environ.get("BENCH_OVERLAP_BUCKET_MB", "4")),
+        reduce_dtype=os.environ.get("BENCH_OVERLAP_DTYPE") or None,
+    )
+    iters = int(os.environ.get("BENCH_ITERS", "10"))
+    on_cpu = jax.devices()[0].platform == "cpu"
+
+    def time_step(step, state, *batch):
+        for _ in range(2):
+            state, loss = step(state, *batch)
+        float(loss)
+
+        def one_window(n):
+            nonlocal state
+            t0 = time.perf_counter()
+            for _ in range(n):
+                state, loss = step(state, *batch)
+            float(loss)  # chained-state sync (see bench_lm)
+            return time.perf_counter() - t0
+
+        dt, _ = _best_window_dt(one_window, iters)
+        return dt / iters
+
+    def ab(build):
+        t_off = time_step(*build(None))
+        t_on = time_step(*build(comm))
+        eff = (t_off - t_on) / t_off
+        get_registry().gauge("comm_overlap_efficiency").set(eff)
+        return {
+            "step_ms_off": round(t_off * 1e3, 2),
+            "step_ms_on": round(t_on * 1e3, 2),
+            "delta_ms": round((t_on - t_off) * 1e3, 2),
+            "overlap_efficiency": round(eff, 4),
+        }
+
+    # ---- ResNet DP (engine/steps.py) — CPU-sized unless overridden -------
+    rng = np.random.default_rng(0)
+    res_name = os.environ.get("BENCH_OVERLAP_MODEL", "ResNet18")
+    res_size = int(os.environ.get("BENCH_OVERLAP_IMAGE", "32" if on_cpu else "224"))
+    res_batch = int(os.environ.get("BENCH_OVERLAP_BATCH", "4")) * jax.device_count()
+    res_mesh = make_mesh()
+    res_model = get_model(res_name, num_classes=100)
+    res_opt = SGD(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    res_state = init_train_state(
+        res_model, res_opt, jax.random.PRNGKey(0),
+        jnp.zeros((1, res_size, res_size, 3)),
+    )
+    res_state = jax.device_put(res_state, replicated_sharding(res_mesh))
+    img = jax.device_put(
+        rng.standard_normal((res_batch, res_size, res_size, 3)).astype(np.float32),
+        batch_sharding(res_mesh, 4),
+    )
+    lab = jax.device_put(
+        rng.integers(0, 100, (res_batch,)).astype(np.int32),
+        batch_sharding(res_mesh, 1),
+    )
+
+    def build_resnet(c):
+        step = build_train_step(
+            res_model, res_opt, multi_step_lr(0.1, [], 0.1), res_mesh,
+            sync_bn=False, donate=False, comm=c,
+        )
+        return step, res_state, img, lab
+
+    resnet = ab(build_resnet)
+
+    # ---- TransformerLM SP (engine/sp_steps.py) ---------------------------
+    vocab = int(os.environ.get("BENCH_OVERLAP_LM_VOCAB", "2048" if on_cpu else "32768"))
+    seq = int(os.environ.get("BENCH_OVERLAP_LM_SEQ", "256" if on_cpu else "2048"))
+    embed = int(os.environ.get("BENCH_OVERLAP_LM_EMBED", "256" if on_cpu else "1024"))
+    depth = int(os.environ.get("BENCH_OVERLAP_LM_DEPTH", "2" if on_cpu else "16"))
+    lm_batch = int(os.environ.get("BENCH_OVERLAP_LM_BATCH", "1")) * jax.device_count()
+    lm_mesh = make_sp_mesh(sequence_parallelism=1)
+    lm = TransformerLM(
+        vocab_size=vocab, max_len=seq, embed_dim=embed, depth=depth,
+        num_heads=4, seq_axis="sequence",
+    )
+    lm_opt = AdamW(lr=3e-4, weight_decay=0.1)
+    toks = rng.integers(0, vocab, (lm_batch, seq + 1)).astype(np.int32)
+    lm_params = lm.init(jax.random.PRNGKey(0), jnp.asarray(toks[:1, :seq]))["params"]
+    lm_state = TrainState(
+        params=lm_params, batch_stats={}, opt_state=lm_opt.init(lm_params)
+    )
+    lm_state = jax.device_put(lm_state, replicated_sharding(lm_mesh))
+    lm_inp = jax.device_put(jnp.asarray(toks[:, :-1]), replicated_sharding(lm_mesh))
+    lm_lab = jax.device_put(jnp.asarray(toks[:, 1:]), replicated_sharding(lm_mesh))
+
+    def build_lm(c):
+        step = build_lm_train_step(
+            lm, lm_opt, cosine_lr(3e-4, 100000), lm_mesh, donate=False, comm=c,
+        )
+        return step, lm_state, lm_inp, lm_lab
+
+    lm_ab = ab(build_lm)
+
+    print(
+        json.dumps(
+            {
+                "metric": "comm.overlap A/B: bucketed backward-overlapped "
+                "reduction vs implicit in-loss reduction (step-time delta)",
+                "value": lm_ab["overlap_efficiency"],
+                "unit": "overlap_efficiency (fraction of baseline step saved)",
+                "lm": lm_ab,
+                "resnet": resnet,
+                "bucket_mb": comm.bucket_mb,
+                "reduce_dtype": comm.reduce_dtype,
+                "comm_bucket_bytes": get_registry()
+                .histogram("comm_bucket_bytes")
+                .snapshot(),
+                "comm_overlap_efficiency_gauge": get_registry()
+                .gauge("comm_overlap_efficiency")
+                .value,
+                "devices": jax.device_count(),
+                "device": jax.devices()[0].device_kind,
+                "cpu_compat_mode": bool(on_cpu),
+            }
+        )
+    )
+
+
 def bench_lint():
     """Run pdt-analyze over the package tree; one-line JSON verdict.
 
@@ -1763,6 +1934,22 @@ def bench_lint():
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else os.environ.get("BENCH_MODE", "step")
+    if mode == "overlap":
+        # must happen before the first jax import (the compile-cache setup
+        # below pulls jax in): give the CPU image a multi-device mesh so the
+        # A/B actually exercises the collective schedule, and allow the
+        # shard_map compat graft (utils/jax_compat.py) so the step builders
+        # run on a vanilla jax install at all
+        fake = os.environ.get(
+            "BENCH_OVERLAP_FAKE_DEVICES",
+            "8" if os.environ.get("JAX_PLATFORMS") == "cpu" else "",
+        )
+        if fake:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={fake}"
+            )
+        os.environ.setdefault("PDT_JAX_COMPAT", "1")
     # Chaos mode measures recovery correctness, not compile latency, and a
     # persistently cached executable reloaded into the rollback/restore
     # path has produced corrupted restores (heap corruption, non-finite
@@ -1790,6 +1977,8 @@ if __name__ == "__main__":
         bench_ckpt()
     elif mode == "telemetry":
         bench_telemetry()
+    elif mode == "overlap":
+        bench_overlap()
     elif mode in ("serve", "--serve"):
         bench_serve()
     elif mode in ("chaos", "--chaos"):

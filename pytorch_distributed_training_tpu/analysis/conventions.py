@@ -47,6 +47,7 @@ BENCH_DRIVERS = (
     "bench_serve(",
     "bench_chaos_serve(",
     "bench_chaos_integrity(",
+    "bench_overlap(",
 )
 
 FAULT_MACHINERY = (

@@ -1,0 +1,373 @@
+"""Gradient-communication planner: bucketed, backward-overlapped reduction.
+
+The DP/SP steps differentiate an objective whose collective sits INSIDE
+the loss (``pmean``/``psum`` of the local loss), so the gradient reduction
+is implicit in shard_map's AD transpose — one logical all-reduce whose
+scheduling is left entirely to XLA.  That is usually fine (XLA's
+latency-hiding scheduler does overlap collectives with independent
+compute), but it gives us no lever: no bucket-size control, no reduction
+dtype, no reduce-scatter construction for weight-update sharding, and on
+builds where the scheduler punts, one monolithic end-of-backward
+all-reduce.
+
+This module is the explicit alternative, the DDP-reducer construction the
+reference gets from torch (arXiv 1811.05233 pipelines reduction behind
+backprop; SURVEY §3.2): differentiate the LOCAL loss — the backward then
+contains no collective at all — and issue one collective per size-bounded,
+dtype-homogeneous bucket of gradient leaves, walked in reverse-flatten
+(approximately last-layer-first) order, each bucket chained to its
+predecessor with ``lax.optimization_barrier`` so the reductions issue in
+backward order while later buckets' producing backward ops are still
+running, instead of being sunk to the end of the program.
+
+Two reduction shapes:
+
+- :func:`reduce_gradients` — one ``psum``/``pmean`` per bucket; the grads
+  come back replicated, any optimizer proceeds unchanged (plain DP).
+- :func:`zero1_update` — one ``psum_scatter`` per bucket; every DP shard
+  owns ``1/n`` of each flat bucket, updates its slice of params + moments
+  with the optimizer's elementwise kernel, and ``all_gather``\\ s fresh
+  params back (ZeRO-1 / arXiv 2004.13336 weight-update sharding: moment
+  memory / n, and the scatter+gather pair moves the same bytes as one
+  all-reduce).
+
+Numerics: the explicit path computes ``reduce(local_grads)`` where the
+implicit path computes ``d reduce(local_loss)``.  For ``psum`` objectives
+(SP) these are the same sum — bitwise-equal at ``grad_accum == 1``.  For
+``pmean`` objectives (DP) the division happens after the sum instead of
+before, identical when the mesh size is a power of two (exponent-only
+scaling) and <= 1e-6 otherwise.  Pinned by tests/test_comm_overlap.py;
+the lever defaults OFF (``training.comm.overlap``).
+
+The planner runs at trace time on tracer shapes (host-side Python), so
+the bucket schedule is a static property of the compiled program — every
+host traces the identical collective sequence, which is what the
+collective-order pass (analysis/collectives.py) audits.
+"""
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..telemetry.registry import get_registry
+
+__all__ = [
+    "CommConfig",
+    "Bucket",
+    "Zero1State",
+    "plan_buckets",
+    "reduce_gradients",
+    "zero1_slot_count",
+    "zero1_init",
+    "zero1_specs",
+    "zero1_shardings",
+    "zero1_update",
+]
+
+# Step-family label for the static collective-order oracle (see
+# analysis/collectives.py and PERF.md): the bucketed reductions issued on
+# behalf of the DP/SP step builders all live in this module.
+PDT_COLLECTIVE_FAMILY = "comm"
+
+
+class CommConfig(NamedTuple):
+    """The additive ``training.comm`` block (engine/topology.parse_comm).
+
+    overlap: master switch — False compiles the exact legacy step.
+    bucket_mb: flat-bucket size bound in MiB (DDP's default is 25).  A
+        single leaf larger than the bound gets a bucket of its own (never
+        split: leaf boundaries are the only static split points).
+    reduce_dtype: optional cast applied to the bucket BEFORE the collective
+        (``"bfloat16"`` halves wire bytes; ``None`` reduces in the grad
+        dtype and is the only setting with parity oracles).
+    """
+
+    overlap: bool = False
+    bucket_mb: float = 25.0
+    reduce_dtype: Optional[str] = None
+
+
+class Bucket(NamedTuple):
+    indices: Tuple[int, ...]  # leaf positions in tree-flatten order
+    dtype: Any  # common dtype of every leaf in the bucket
+    size: int  # total element count
+
+
+class Zero1State(NamedTuple):
+    """Flat, DP-sharded optimizer state for the ZeRO-1 path.
+
+    ``slots[s][b]`` is moment slot ``s`` of bucket ``b`` as one flat
+    buffer, length padded to a multiple of the DP shard count and sharded
+    ``P(data)`` — each replica materializes only its ``1/n`` slice.
+    ``step`` stays a replicated scalar so ``TrainState.step`` / the LR
+    schedule read it exactly like the dense states.
+    """
+
+    slots: Tuple[Tuple[jnp.ndarray, ...], ...]
+    step: jnp.ndarray
+
+
+def plan_buckets(leaves, bucket_mb: float) -> List[Bucket]:
+    """Partition gradient leaves into size-bounded, dtype-homogeneous
+    buckets in REVERSE flatten order.
+
+    Reverse order approximates last-produced-first: flax flattens blocks
+    in definition order, so the head/deepest blocks — whose gradients the
+    backward pass finishes first — lead the schedule, and their reduction
+    issues while shallower layers are still differentiating (the DDP
+    bucket-order heuristic; torch caches the true autograd order after
+    step 1, we settle for the static approximation).
+
+    A dtype change closes the current bucket (mixed buffers would silently
+    cast someone), as does exceeding ``bucket_mb``; an oversized leaf
+    becomes a singleton bucket — leaf boundaries are the only split points.
+    Works on anything with ``.size``/``.dtype`` (tracers, ShapeDtypeStruct,
+    concrete arrays), so the same plan serves trace time and init time.
+    """
+    cap = int(bucket_mb * 2**20)
+    out: List[Bucket] = []
+    cur: List[int] = []
+    cur_bytes = 0
+
+    def close():
+        nonlocal cur, cur_bytes
+        if cur:
+            dt = jnp.result_type(leaves[cur[0]])
+            out.append(
+                Bucket(tuple(cur), dt, sum(leaves[i].size for i in cur))
+            )
+            cur, cur_bytes = [], 0
+
+    for i in reversed(range(len(leaves))):
+        leaf = leaves[i]
+        nbytes = leaf.size * jnp.result_type(leaf).itemsize
+        if cur and (
+            jnp.result_type(leaf) != jnp.result_type(leaves[cur[0]])
+            or cur_bytes + nbytes > cap
+        ):
+            close()
+        cur.append(i)
+        cur_bytes += nbytes
+    close()
+    return out
+
+
+def _record_plan(plan: List[Bucket]) -> None:
+    """Observe per-bucket wire bytes once per trace (the plan is static)."""
+    hist = get_registry().histogram("comm_bucket_bytes")
+    for b in plan:
+        hist.observe(float(b.size * b.dtype.itemsize))
+
+
+def _bucket_flat(leaves, bucket: Bucket):
+    parts = [leaves[i].reshape(-1) for i in bucket.indices]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def _bucket_unflat(out, flat, leaves, bucket: Bucket) -> None:
+    """Scatter a reduced flat bucket back into per-leaf slots of ``out``."""
+    offsets = []
+    acc = 0
+    for i in bucket.indices[:-1]:
+        acc += leaves[i].size
+        offsets.append(acc)
+    parts = jnp.split(flat, offsets) if offsets else [flat]
+    for i, part in zip(bucket.indices, parts):
+        out[i] = part.reshape(leaves[i].shape).astype(leaves[i].dtype)
+
+
+def reduce_gradients(grads, cfg: CommConfig, axis_name, op: str = "pmean"):
+    """Bucketed cross-replica gradient reduction with a pinned schedule.
+
+    ``grads`` must be LOCAL (unreduced) gradients — i.e. the caller
+    differentiated a loss with no internal collective.  Returns the tree
+    with every leaf ``psum``- or ``pmean``-reduced over ``axis_name``,
+    one collective per bucket.
+
+    The ``optimization_barrier`` chain ties bucket *k*'s input to bucket
+    *k-1*'s reduced output: XLA may neither hoist a later bucket's
+    reduction above an earlier one nor sink them all to the end, so the
+    schedule stays "reduce bucket k while the backward that produces
+    bucket k+1 is still running" — the DDP reducer's pipeline, expressed
+    as data dependencies.
+    """
+    if op not in ("psum", "pmean"):
+        raise ValueError(f"reduce_gradients op must be psum or pmean, got {op!r}")
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+    plan = plan_buckets(leaves, cfg.bucket_mb)
+    _record_plan(plan)
+    rdt = jnp.dtype(cfg.reduce_dtype) if cfg.reduce_dtype else None
+    out = [None] * len(leaves)
+    prev = None
+    for bucket in plan:
+        flat = _bucket_flat(leaves, bucket)
+        if rdt is not None and flat.dtype != rdt:
+            flat = flat.astype(rdt)
+        if prev is not None:
+            flat, prev = jax.lax.optimization_barrier((flat, prev))
+        if op == "psum":
+            red = jax.lax.psum(flat, axis_name)
+        else:
+            red = jax.lax.pmean(flat, axis_name)
+        prev = red
+        _bucket_unflat(out, red, leaves, bucket)
+    return jax.tree.unflatten(treedef, out)
+
+
+# --------------------------------------------------------------------- #
+# ZeRO-1: reduce-scatter + sharded elementwise update + all-gather
+# --------------------------------------------------------------------- #
+
+
+def zero1_slot_count(optimizer) -> int:
+    """Moment-slot count of an optimizer whose update is elementwise.
+
+    The ZeRO-1 construction updates FLAT 1/n slices, so it composes only
+    with optimizers whose per-leaf update is elementwise (the ``_one``
+    kernels): SGD (1 momentum slot) and AdamW (2 moment slots).  LARS/LAMB
+    take per-parameter norms — a flat slice of concatenated leaves destroys
+    the layer boundaries those norms are taken over.
+    """
+    from ..optimizers import SGD, AdamW
+
+    if isinstance(optimizer, AdamW):
+        if getattr(optimizer, "exclude_norm_bias", False):
+            raise ValueError(
+                "optimizer.exclude_norm_bias is not supported with the "
+                "ZeRO-1 comm path: flat gradient shards erase the "
+                "parameter ranks the exclusion rule is keyed on"
+            )
+        return 2
+    if isinstance(optimizer, SGD):
+        return 1
+    raise ValueError(
+        f"optimizer {type(optimizer).__name__} is not supported with "
+        "training.comm.overlap + zero stage 1 (needs an elementwise "
+        "update kernel: SGD or AdamW; LARS/LAMB trust ratios do not "
+        "survive flat 1/n gradient shards)"
+    )
+
+
+def _one_fn(optimizer, lr, step):
+    """The optimizer's elementwise per-leaf kernel, ready for flat slices."""
+    from ..optimizers import SGD
+
+    if isinstance(optimizer, SGD):
+        return optimizer._one(lr, step == 0)
+    return optimizer._one(lr, step)
+
+
+def _padded(size: int, num_shards: int) -> int:
+    return -(-size // num_shards) * num_shards
+
+
+def zero1_init(optimizer, params, cfg: CommConfig, num_shards: int) -> Zero1State:
+    """Flat bucketed moment buffers (zeros), GLOBAL shapes.
+
+    Buffers are created full-length here and sharded by ``device_put``
+    with :func:`zero1_shardings` — each replica then holds ``1/n`` of
+    every bucket, the ZeRO-1 memory claim.
+    """
+    n_slots = zero1_slot_count(optimizer)
+    leaves = jax.tree.leaves(params)
+    plan = plan_buckets(leaves, cfg.bucket_mb)
+    slots = tuple(
+        tuple(
+            jnp.zeros((_padded(b.size, num_shards),), b.dtype) for b in plan
+        )
+        for _ in range(n_slots)
+    )
+    return Zero1State(slots=slots, step=jnp.zeros((), dtype=jnp.int32))
+
+
+def zero1_specs(data_axis: str) -> Zero1State:
+    """shard_map in/out spec PREFIX for a :class:`Zero1State`: every slot
+    buffer split over the DP axis, the step counter replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    return Zero1State(slots=P(data_axis), step=P())
+
+
+def zero1_shardings(state: Zero1State, mesh, data_axis: str) -> Zero1State:
+    """``device_put`` shardings matching :func:`zero1_specs`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(data_axis))
+    return Zero1State(
+        slots=jax.tree.map(lambda _: shard, state.slots),
+        step=NamedSharding(mesh, P()),
+    )
+
+
+def zero1_update(
+    optimizer,
+    cfg: CommConfig,
+    grads,
+    params,
+    state: Zero1State,
+    lr,
+    axis_name: str,
+    num_shards: int,
+):
+    """One ZeRO-1 step over the bucketed schedule (inside shard_map).
+
+    Per bucket, in reverse-backward order: ``psum_scatter`` the LOCAL flat
+    gradient (wire cost of half an all-reduce) so each replica holds the
+    fully-reduced ``1/n`` slice; run the optimizer's elementwise kernel on
+    that slice of params + moments; ``all_gather`` the updated slice back
+    to full params (the other half of the all-reduce).  Moments never
+    exist unsharded — that is the memory claim of arXiv 2004.13336.
+
+    Gradients must be LOCAL SUMS (the SP objective convention: partial
+    losses normalized by the global token count), so the scattered psum is
+    exactly the global gradient.  Same barrier chain as
+    :func:`reduce_gradients` pins the bucket issue order.
+    """
+    leaves, treedef = jax.tree.flatten(grads)
+    param_leaves = treedef.flatten_up_to(params)
+    plan = plan_buckets(leaves, cfg.bucket_mb)
+    _record_plan(plan)
+    rdt = jnp.dtype(cfg.reduce_dtype) if cfg.reduce_dtype else None
+    one = _one_fn(optimizer, lr, state.step)
+    n_slots = len(state.slots)
+    idx = jax.lax.axis_index(axis_name)
+    new_param_leaves = [None] * len(leaves)
+    new_slots: List[List[jnp.ndarray]] = [[] for _ in range(n_slots)]
+    prev = None
+    for b, bucket in enumerate(plan):
+        padded = _padded(bucket.size, num_shards)
+        shard_len = padded // num_shards
+        flat_g = _bucket_flat(leaves, bucket)
+        if padded != bucket.size:
+            flat_g = jnp.pad(flat_g, (0, padded - bucket.size))
+        if rdt is not None and flat_g.dtype != rdt:
+            flat_g = flat_g.astype(rdt)
+        if prev is not None:
+            flat_g, prev = jax.lax.optimization_barrier((flat_g, prev))
+        g_shard = jax.lax.psum_scatter(
+            flat_g, axis_name, scatter_dimension=0, tiled=True
+        )
+        prev = g_shard
+        if rdt is not None:
+            g_shard = g_shard.astype(bucket.dtype)
+        flat_p = _bucket_flat(param_leaves, bucket)
+        if padded != bucket.size:
+            flat_p = jnp.pad(flat_p, (0, padded - bucket.size))
+        p_shard = jax.lax.dynamic_slice(
+            flat_p, (idx * shard_len,), (shard_len,)
+        )
+        res = one(g_shard, p_shard, *(state.slots[s][b] for s in range(n_slots)))
+        for s in range(n_slots):
+            new_slots[s].append(res[1 + s])
+        full = jax.lax.all_gather(res.param, axis_name, tiled=True)
+        if padded != bucket.size:
+            full = full[: bucket.size]
+        _bucket_unflat(new_param_leaves, full, param_leaves, bucket)
+    new_state = Zero1State(
+        slots=tuple(tuple(s) for s in new_slots), step=state.step + 1
+    )
+    return jax.tree.unflatten(treedef, new_param_leaves), new_state

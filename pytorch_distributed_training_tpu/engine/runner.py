@@ -67,6 +67,7 @@ from .profiling import TraceProfiler
 from .steps import TrainState
 from .topology import (
     parse_batch,
+    parse_comm,
     parse_elastic,
     parse_fault_tolerance,
     parse_integrity,
@@ -181,6 +182,9 @@ class Runner:
         # documented config error lives there).
         parse_topology(self, cfg, train_cfg, train_dataset)
         host_batch = parse_batch(self, train_cfg)
+        # Gradient-communication keys (additive, off by default): bucketed
+        # backward-overlapped reduction + ZeRO-1 routing (engine/comm.py).
+        parse_comm(self, train_cfg)
         # Fault-tolerance keys (additive, all off by default) + the fault
         # injector: the PDT_FAULT_SPEC env var wins over the config key so a
         # chaos wrapper can override any run (engine/fault.py).

@@ -32,6 +32,7 @@ from ..metrics import accuracy
 from ..ops import cross_entropy_loss
 from ..parallel.mesh import DATA_AXIS
 from ..telemetry.retrace import register_compiled
+from .comm import reduce_gradients
 
 __all__ = [
     "TrainState",
@@ -120,6 +121,7 @@ def build_train_step(
     label_smoothing: float = 0.0,
     ema_decay: Optional[float] = None,
     anomaly_factor: Optional[float] = None,
+    comm=None,
 ):
     """Compile the full training iteration as one SPMD program.
 
@@ -165,8 +167,17 @@ def build_train_step(
         bitwise-identical.  The step then returns ``(state, loss, gnorm,
         applied)`` instead of ``(state, loss)``; ``None`` (the default)
         compiles the exact ungated program.
+      comm: optional :class:`..engine.comm.CommConfig` (config
+        ``training.comm``).  With ``comm.overlap`` the objective becomes
+        the LOCAL shard mean — the backward then carries no collective —
+        and the gradients are reduced explicitly afterward as one bucketed
+        ``pmean`` per size-bounded bucket in reverse-backward order
+        (engine/comm.py).  ``psum(g/n)`` becomes ``psum(g)/n``: bitwise on
+        power-of-two meshes, <= 1e-6 otherwise (tests/test_comm_overlap.py).
+        ``None``/``overlap: false`` compiles the exact legacy step.
     """
     normalize = _input_normalizer(input_norm)
+    overlap = comm is not None and comm.overlap
 
     def micro_loss(params, batch_stats, img, label):
         # normalize PER MICRO-BATCH: converting uint8 -> f32 up front would
@@ -192,7 +203,11 @@ def build_train_step(
             # tests/test_engine.py::test_dp_step_matches_single_device).
             # XLA still overlaps the underlying all-reduce with independent
             # backward compute, like DDP's bucketed reducer (reference :198).
-            loss = jax.lax.pmean(loss, DATA_AXIS)
+            # comm.overlap instead differentiates the LOCAL mean and moves
+            # the reduction after the backward as explicit bucketed pmeans
+            # with a pinned schedule (engine/comm.py).
+            if not overlap:
+                loss = jax.lax.pmean(loss, DATA_AXIS)
             # models without batch statistics (e.g. ViT) mutate nothing
             return loss, mutated.get("batch_stats", {})
 
@@ -233,6 +248,11 @@ def build_train_step(
             )
         else:
             (loss, new_bs), grads = micro_loss(params, batch_stats, img, label)
+        if overlap:
+            # grads/loss are local shard means here; the bucketed pmeans
+            # reproduce the implicit reduction (psum(g)/n vs psum(g/n))
+            grads = reduce_gradients(grads, comm, DATA_AXIS, op="pmean")
+            loss = jax.lax.pmean(loss, DATA_AXIS)
         if not sync_bn:
             # Local BN stats diverge per replica; average them so the state
             # stays replicated (the reference's DDP broadcast_buffers keeps
@@ -320,7 +340,9 @@ def build_train_step(
                 ok.astype(jnp.float32),
             )
 
-        return register_compiled("train_step/gspmd_guarded", train_step)
+        return register_compiled(
+            f"train_step/gspmd{'_overlap' if overlap else ''}_guarded", train_step
+        )
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def train_step(state: TrainState, img, label):
@@ -338,7 +360,9 @@ def build_train_step(
             loss,
         )
 
-    return register_compiled("train_step/gspmd", train_step)
+    return register_compiled(
+        f"train_step/gspmd{'_overlap' if overlap else ''}", train_step
+    )
 
 
 def build_eval_step(model, mesh: Mesh, input_norm=None):

@@ -36,6 +36,7 @@ __all__ = [
     "SGD",
     "LARS",
     "AdamW",
+    "LAMB",
     "get_optimizer",
     "OPTIMIZERS",
     "SGDState",
@@ -293,6 +294,13 @@ class AdamW:
     and applied to the bias-corrected denom — replicated exactly (the optax
     ``adamw`` eps placement differs).  The default LM optimizer beyond the
     reference's SGD-only surface (transformers want Adam-family updates).
+
+    ``exclude_norm_bias=True`` enables the standard transformer recipe of
+    applying NO weight decay to biases and norm scales/offsets (detected by
+    the same rank<=1 rule as LARS, see ``_is_excluded``): excluded leaves
+    skip step 1 entirely, everything else is unchanged.  With the default
+    ``False`` the update is bitwise identical to before the flag existed.
+    Config surface: ``training.optimizer.exclude_norm_bias: true``.
     """
 
     def __init__(
@@ -302,12 +310,14 @@ class AdamW:
         eps: float = 1e-8,
         weight_decay: float = 1e-2,
         fused: bool = False,
+        exclude_norm_bias: bool = False,
     ):
         self.lr = float(lr)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
         self.eps = float(eps)
         self.weight_decay = float(weight_decay)
         self.fused = bool(fused)
+        self.exclude_norm_bias = bool(exclude_norm_bias)
 
     def init(self, params) -> AdamWState:
         return AdamWState(
@@ -316,8 +326,10 @@ class AdamW:
             step=jnp.zeros((), dtype=jnp.int32),
         )
 
-    def _one(self, lr, step):
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+    def _one(self, lr, step, wd=None):
+        b1, b2, eps = self.b1, self.b2, self.eps
+        if wd is None:
+            wd = self.weight_decay
         t = (step + 1).astype(jnp.float32)
         bc1 = 1.0 - b1**t
         bc2 = 1.0 - b2**t
@@ -331,10 +343,28 @@ class AdamW:
 
         return one
 
+    def _pre_step(self, lr, step, params):
+        """Resolve the exclude_norm_bias split: (params, per-leaf fn).
+
+        When the flag is on, decoupled decay is applied here as a per-leaf
+        pre-pass over the non-excluded leaves only (exclusion is a static
+        per-leaf property, so it cannot live inside a fused elementwise fn
+        that concatenates leaves), and ``_one`` then runs with wd = 0 for
+        every leaf.  For non-excluded leaves the composition is bitwise the
+        default path: ``p * (1 - lr*wd)`` then wd-free adam.
+        """
+        if self.exclude_norm_bias and self.weight_decay != 0.0:
+            wd = self.weight_decay
+            params = jax.tree.map(
+                lambda p: p if _is_excluded(p) else p * (1.0 - lr * wd), params
+            )
+            return params, self._one(lr, step, wd=0.0)
+        return params, self._one(lr, step)
+
     def update(self, grads, state: AdamWState, params, lr=None):
         if lr is None:
             lr = self.lr
-        one = self._one(lr, state.step)
+        params, one = self._pre_step(lr, state.step, params)
         new_params, new_mu, new_nu = _apply_map(
             self.fused, one, 3, grads, params, state.mu, state.nu
         )
@@ -342,7 +372,7 @@ class AdamW:
 
     def update_with_ema(self, grads, state: AdamWState, params, lr, ema, decay):
         """Parameter update + EMA fold in one pass (see ``SGD.update_with_ema``)."""
-        one = self._one(lr, state.step)
+        params, one = self._pre_step(lr, state.step, params)
         d = decay
 
         def one_ema(g, p, mu, nu, e):
@@ -359,10 +389,77 @@ class AdamW:
         )
 
 
+class LAMB:
+    """Layer-wise Adaptive Moments (You et al., 2019) — LARS for Adam.
+
+    Completes the large-batch recipe pair: LARS covers the SGD/ResNet pod
+    configs, LAMB is its Adam-family counterpart for large-batch transformer
+    pretraining (the paper's BERT-in-76-minutes recipe).  Per non-excluded
+    param (rank >= 2, see ``_is_excluded``):
+
+      1. adam moments ``mu <- b1*mu + (1-b1)*g``, ``nu <- b2*nu + (1-b2)*g^2``
+      2. bias-corrected update ``u = (mu/bc1) / (sqrt(nu/bc2) + eps)``
+         (eps INSIDE the ratio, per the paper's Algorithm 2 — this is NOT
+         the torch-AdamW eps placement)
+      3. decoupled decay folded into the direction: ``u <- u + wd * p``
+      4. trust ratio ``r = ||p|| / ||u||`` where both norms > 0 else 1
+      5. ``p <- p - lr * r * u``
+
+    Excluded params (biases, norm scale/offset) take the same step with
+    wd = 0 and r = 1.  Per-leaf norms are reductions, so — like LARS — LAMB
+    has no fused mode (concatenation would not commute with step 4).
+    Reuses ``AdamWState``: the moment pytrees and step counter are identical.
+    """
+
+    def __init__(
+        self,
+        lr: float,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.0,
+    ):
+        self.lr = float(lr)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+
+    def init(self, params) -> AdamWState:
+        return AdamWState(
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def update(self, grads, state: AdamWState, params, lr=None):
+        if lr is None:
+            lr = self.lr
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        t = (state.step + 1).astype(jnp.float32)
+        bc1 = 1.0 - b1**t
+        bc2 = 1.0 - b2**t
+
+        def one(g, p, mu, nu):
+            new_mu = b1 * mu + (1.0 - b1) * g
+            new_nu = b2 * nu + (1.0 - b2) * jnp.square(g)
+            u = (new_mu / bc1) / (jnp.sqrt(new_nu / bc2) + eps)
+            if _is_excluded(p):
+                return _Out(p - lr * u, new_mu, new_nu)
+            u = u + wd * p
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            trust = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0)
+            return _Out(p - lr * trust * u, new_mu, new_nu)
+
+        flat = jax.tree.map(one, grads, params, state.mu, state.nu)
+        new_params, new_mu, new_nu = _unzip(flat, 3)
+        return new_params, AdamWState(mu=new_mu, nu=new_nu, step=state.step + 1)
+
+
 OPTIMIZERS = {
     "SGD": SGD,
     "LARS": LARS,
     "AdamW": AdamW,
+    "LAMB": LAMB,
 }
 
 

@@ -118,6 +118,11 @@ SUPPORTED = [
     ("lm-smoothing", _cfg(label_smoothing=0.1)),
     ("img-ema", _cfg(task="img", ema={"decay": 0.99})),
     ("img-grad-accum", _cfg(task="img", grad_accumulation=2)),
+    ("img-comm-overlap", _cfg(task="img", comm={"overlap": True,
+                                                "bucket_mb": 1})),
+    ("lm-comm-overlap", _cfg(comm={"overlap": True, "bucket_mb": 1})),
+    ("lm-comm-zero1", _cfg(zero=True, comm={"overlap": True,
+                                            "bucket_mb": 1})),
 ]
 
 # (id, cfg, error-message fragment) — combinations that MUST raise.
@@ -151,6 +156,16 @@ UNSUPPORTED = [
     ("ppxlars", _cfg(pipeline_parallelism=2, microbatches=4,
                      optimizer={"name": "LARS", "lr": 0.01}),
      "LARS is not supported with"),
+    ("commxpp", _cfg(pipeline_parallelism=2, microbatches=4,
+                     comm={"overlap": True}),
+     "comm.overlap is not wired for the pipeline"),
+    ("commxtp", _cfg(tensor_parallelism=2, comm={"overlap": True}),
+     "comm.overlap is not wired for the gspmd"),
+    ("commxzero2", _cfg(zero=2, comm={"overlap": True}),
+     "comm.overlap is not wired for the gspmd"),
+    ("comm-zero1xsp2", _cfg(zero=True, sequence_parallelism=2,
+                            comm={"overlap": True}),
+     "zero stage 1 requires"),
 ]
 
 

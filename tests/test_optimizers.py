@@ -9,7 +9,13 @@ import numpy as np
 import pytest
 import torch
 
-from pytorch_distributed_training_tpu.optimizers import LARS, SGD, AdamW, get_optimizer
+from pytorch_distributed_training_tpu.optimizers import (
+    LAMB,
+    LARS,
+    SGD,
+    AdamW,
+    get_optimizer,
+)
 
 
 def _run_parity(momentum, weight_decay, nesterov, dampening=0.0, steps=6):
@@ -87,6 +93,7 @@ def test_factory():
     assert get_optimizer({"name": "SGD"}) is SGD
     assert get_optimizer({"name": "LARS"}) is LARS
     assert get_optimizer({"name": "AdamW"}) is AdamW
+    assert get_optimizer({"name": "LAMB"}) is LAMB
     with pytest.raises(KeyError):
         get_optimizer({"name": "Adam"})
 
@@ -198,13 +205,208 @@ def test_lars_exclusion_lm_tree():
     assert not any("embedding" in p or p.endswith("kernel") for p in excluded)
 
 
+# --------------------------------------------------------------------- #
+# LAMB (You et al., 2019)
+# --------------------------------------------------------------------- #
+
+
+def _np_lamb_step(p, g, mu, nu, t, lr, b1, b2, eps, wd):
+    """Float64 numpy reference of one LAMB step (paper Algorithm 2)."""
+    mu = b1 * mu + (1.0 - b1) * g
+    nu = b2 * nu + (1.0 - b2) * g * g
+    u = (mu / (1.0 - b1**t)) / (np.sqrt(nu / (1.0 - b2**t)) + eps)
+    if p.ndim >= 2:
+        u = u + wd * p
+        p_norm = np.linalg.norm(p)
+        u_norm = np.linalg.norm(u)
+        trust = p_norm / u_norm if (p_norm > 0 and u_norm > 0) else 1.0
+    else:
+        trust = 1.0  # excluded: no decay, no trust ratio
+    return p - lr * trust * u, mu, nu
+
+
+def test_lamb_first_step_hand_computed():
+    """Step 1 with a constant gradient has a closed form: bias correction
+    makes m_hat = g and v_hat = g^2, so u ~= sign(g) (eps-perturbed), the
+    trust ratio is ||p|| / ||sign(g)|| = ||p|| / 2 for a 2x2 param, and
+    p1 = p0 - lr * (||p0||/2) * sign(g)."""
+    p0 = np.array([[3.0, 0.0], [0.0, 4.0]], dtype=np.float32)  # ||p0|| = 5
+    g = np.array([[1.0, -2.0], [0.5, -0.25]], dtype=np.float32)
+    opt = LAMB(lr=0.1, eps=0.0, weight_decay=0.0)
+    params = [jnp.asarray(p0)]
+    state = opt.init(params)
+    new_params, state = opt.update([jnp.asarray(g)], state, params)
+    expected = p0 - 0.1 * (5.0 / 2.0) * np.sign(g)
+    np.testing.assert_allclose(np.asarray(new_params[0]), expected, rtol=1e-6)
+    assert int(state.step) == 1
+
+
+def test_lamb_multistep_numpy_reference():
+    """6 steps on a matrix + bias tree against the float64 numpy reference,
+    with weight decay engaged on the matrix only."""
+    rng = np.random.default_rng(11)
+    shapes = [(4, 3), (5,)]
+    lr, b1, b2, eps, wd = 0.02, 0.9, 0.999, 1e-6, 0.1
+    params_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+    ref_p = [p.astype(np.float64) for p in params_np]
+    ref_mu = [np.zeros_like(p) for p in ref_p]
+    ref_nu = [np.zeros_like(p) for p in ref_p]
+
+    opt = LAMB(lr=lr, betas=(b1, b2), eps=eps, weight_decay=wd)
+    params = [jnp.asarray(p) for p in params_np]
+    state = opt.init(params)
+    for t in range(1, 7):
+        grads_np = [rng.normal(size=s).astype(np.float32) for s in shapes]
+        for i in range(len(shapes)):
+            ref_p[i], ref_mu[i], ref_nu[i] = _np_lamb_step(
+                ref_p[i], grads_np[i].astype(np.float64),
+                ref_mu[i], ref_nu[i], t, lr, b1, b2, eps, wd,
+            )
+        params, state = opt.update([jnp.asarray(g) for g in grads_np], state, params)
+    for ours, ref in zip(params, ref_p):
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-5, atol=1e-6)
+
+
+def test_lamb_excluded_params_skip_decay_and_trust():
+    """A rank-1 param must take a plain bias-corrected adam step: identical
+    whether weight_decay is 0 or huge."""
+    bias = [jnp.linspace(-1.0, 1.0, 7)]
+    grad = [jnp.full((7,), 0.3)]
+    outs = []
+    for wd in (0.0, 10.0):
+        opt = LAMB(lr=0.01, weight_decay=wd)
+        state = opt.init(bias)
+        new_params, _ = opt.update(grad, state, bias)
+        outs.append(np.asarray(new_params[0]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_lamb_zero_param_trust_falls_back_to_one():
+    """||p|| = 0 must not zero the step (trust -> 1, per the paper's phi)."""
+    params = [jnp.zeros((3, 3))]
+    grads = [jnp.ones((3, 3))]
+    opt = LAMB(lr=0.1, weight_decay=0.0)
+    state = opt.init(params)
+    new_params, _ = opt.update(grads, state, params)
+    out = np.asarray(new_params[0])
+    assert np.all(np.isfinite(out)) and np.all(out != 0.0)
+
+
+# --------------------------------------------------------------------- #
+# AdamW exclude_norm_bias (no weight decay on norm scales / biases)
+# --------------------------------------------------------------------- #
+
+
+def _tree_bitwise_equal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _run_adamw_flag(steps=3, **kwargs):
+    rng = np.random.default_rng(3)
+    params = {
+        "kernel": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+        "bias": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+        "scale": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+    }
+    opt = AdamW(lr=1e-2, weight_decay=0.1, **kwargs)
+    state = opt.init(params)
+    for _ in range(steps):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                rng.normal(size=p.shape).astype(np.float32)
+            ),
+            params,
+        )
+        params, state = opt.update(grads, state, params)
+    return params
+
+
+def test_adamw_exclude_norm_bias_splits_decay():
+    """Flag on: rank>=2 leaves bitwise-match the default (decayed) path,
+    rank<=1 leaves bitwise-match the wd=0 path."""
+    on = _run_adamw_flag(exclude_norm_bias=True)
+    default = _run_adamw_flag()
+    rng = np.random.default_rng(3)  # same param/grad stream, wd=0
+    params = {
+        "kernel": jnp.asarray(rng.normal(size=(4, 3)).astype(np.float32)),
+        "bias": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+        "scale": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+    }
+    opt0 = AdamW(lr=1e-2, weight_decay=0.0)
+    state = opt0.init(params)
+    for _ in range(3):
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                rng.normal(size=p.shape).astype(np.float32)
+            ),
+            params,
+        )
+        params, state = opt0.update(grads, state, params)
+    no_decay = params
+
+    np.testing.assert_array_equal(np.asarray(on["kernel"]), np.asarray(default["kernel"]))
+    np.testing.assert_array_equal(np.asarray(on["bias"]), np.asarray(no_decay["bias"]))
+    np.testing.assert_array_equal(np.asarray(on["scale"]), np.asarray(no_decay["scale"]))
+    # and the flag genuinely changes the rank<=1 leaves vs. the default path
+    assert not np.array_equal(np.asarray(on["bias"]), np.asarray(default["bias"]))
+
+
+def test_adamw_exclude_norm_bias_default_off_bitwise():
+    """Flag absent == flag False, bitwise (additive-change oracle)."""
+    _tree_bitwise_equal(_run_adamw_flag(), _run_adamw_flag(exclude_norm_bias=False))
+
+
+def test_adamw_exclude_norm_bias_fused_bitwise():
+    """The pre-decay pass must commute with the fused dtype-group buffers."""
+    _tree_bitwise_equal(
+        _run_adamw_flag(exclude_norm_bias=True),
+        _run_adamw_flag(exclude_norm_bias=True, fused=True),
+    )
+
+
+def test_adamw_exclude_norm_bias_ema_path():
+    """update_with_ema must honor the flag identically to update."""
+    params = {
+        "kernel": jnp.ones((3, 3)) * 0.5,
+        "bias": jnp.ones((3,)) * 0.5,
+    }
+    grads = jax.tree.map(lambda p: 0.1 * jnp.ones_like(p), params)
+    opt = AdamW(lr=1e-2, weight_decay=0.5, exclude_norm_bias=True)
+    state = opt.init(params)
+    ema = jax.tree.map(jnp.copy, params)
+    p_ema, _, _ = opt.update_with_ema(grads, state, params, 1e-2, ema, 0.99)
+    p_plain, _ = opt.update(grads, state, params, 1e-2)
+    _tree_bitwise_equal(p_ema, p_plain)
+
+
+def test_optimizer_yaml_kwargs_wiring():
+    """The runner instantiates get_optimizer(cfg)(**cfg-minus-name): the new
+    keys must round-trip from a YAML-shaped dict, and typos must fail loudly."""
+    cfg = {"name": "AdamW", "lr": 1e-3, "weight_decay": 0.01,
+           "exclude_norm_bias": True}
+    cls = get_optimizer(cfg)
+    kwargs = {k: v for k, v in cfg.items() if k != "name"}
+    opt = cls(**kwargs)
+    assert opt.exclude_norm_bias is True and opt.weight_decay == 0.01
+
+    lamb_cfg = {"name": "LAMB", "lr": 2e-3, "weight_decay": 0.1,
+                "betas": [0.9, 0.98]}
+    lamb = get_optimizer(lamb_cfg)(**{k: v for k, v in lamb_cfg.items() if k != "name"})
+    assert lamb.b2 == 0.98 and lamb.weight_decay == 0.1
+
+    with pytest.raises(TypeError):
+        AdamW(lr=1e-3, exclude_normbias=True)  # typo'd key fails at ctor
+
+
 def test_tuple_structured_params_not_corrupted():
     """The update's internal unzip uses a dedicated result type, so params
     stored in a tuple pytree must round-trip with their structure intact
     (a bare isinstance(t, tuple) is_leaf would swallow the container)."""
     params = (jnp.ones((2, 2)), jnp.zeros((3,)))
     grads = (jnp.full((2, 2), 0.1), jnp.full((3,), 0.2))
-    for opt in (SGD(lr=0.1, momentum=0.9), LARS(lr=0.1), AdamW(lr=1e-3)):
+    for opt in (SGD(lr=0.1, momentum=0.9), LARS(lr=0.1), AdamW(lr=1e-3),
+                LAMB(lr=1e-3)):
         state = opt.init(params)
         new_params, _ = opt.update(grads, state, params)
         assert isinstance(new_params, tuple) and len(new_params) == 2

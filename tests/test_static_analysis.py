@@ -331,16 +331,25 @@ def test_collective_order_oracle_matches_perf_md():
     step builders must reproduce these sequences EXACTLY — reordering or
     dropping a collective changes multi-host semantics."""
     seqs = extract_collective_sequences(PKG)
-    assert set(seqs) == {"dp", "sp", "tp", "pp"}
+    assert set(seqs) == {"dp", "sp", "tp", "pp", "comm"}
 
     def ops(family, builder):
         return [c.op for c in seqs[family][builder]]
 
-    assert ops("dp", "build_train_step") == ["pmean", "pmean"]
+    # PR 11: the dp/sp builders gained the comm.overlap branch — one extra
+    # lexical pmean/psum each (the explicit post-backward reduction +
+    # loss reduction; config-uniform `if overlap:` branches, so the pass
+    # sees both arms).  The default-off path still traces the original
+    # sequence; bitwise parity is pinned in tests/test_comm_overlap.py.
+    assert ops("dp", "build_train_step") == ["pmean", "pmean", "pmean"]
     assert ops("dp", "build_eval_step") == ["pmean"]
     assert ops("dp", "build_eval_step_exact") == ["psum"]
-    assert ops("sp", "build_lm_train_step") == ["psum"]
+    assert ops("sp", "build_lm_train_step") == ["psum", "psum", "psum"]
     assert ops("sp", "build_lm_eval_step") == ["psum", "pmean"]
+    # the bucketed reducers themselves live in family "comm": plain-DP
+    # reduce (psum|pmean per bucket) and the ZeRO-1 scatter/gather pair
+    assert ops("comm", "reduce_gradients") == ["psum", "pmean"]
+    assert ops("comm", "zero1_update") == ["psum_scatter", "all_gather"]
     assert ops("pp", "build_pp_lm_train_step") == [
         "ppermute",
         "psum",
