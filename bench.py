@@ -854,6 +854,13 @@ def bench_serve():
                     {"prefix_hit_rate": round(snap["prefix_hit_rate"], 3)}
                     if "prefix_hit_rate" in snap else {}
                 ),
+                **(
+                    {
+                        "block_util_mean": round(snap["block_util_mean"], 3),
+                        "block_util_max": round(snap["block_util_max"], 3),
+                    }
+                    if "block_util_mean" in snap else {}
+                ),
                 # LM-only phase split (round 6): prefill is the batched
                 # prompt forward (prompt tokens/s), decode the incremental
                 # KV-cache loop (generated tokens/s) — absent for images
@@ -1314,6 +1321,287 @@ def bench_chaos_serve():
                 "retry_attempts": counters.get("retry_attempts", 0),
                 "retry_exhausted": counters.get("retry_exhausted", 0),
                 **counters,
+            }
+        )
+    )
+
+
+def bench_chaos_fleet():
+    """Chaos-fleet mode: kill 1 of N serving replicas mid-stream.
+
+    Builds a :class:`ServingFleet` (N continuous-scheduler replicas
+    behind the health-aware router), streams a mixed-genlen workload
+    into it, and hard-kills one replica via the ``replica_down`` fault
+    kind while requests are in flight.  The router fails the dead
+    replica's requests over to survivors with token-identical replay
+    (re-prefill prompt + delivered tokens through the survivor's decode
+    program, original sampling keys).  The oracle: every request
+    completes with a token stream **bitwise equal** to an unkilled twin
+    run of the same fleet — greedy AND sampled — with zero
+    replay/fleet parity mismatches.  One JSON line of recovery counters.
+
+      PDT_FAULT_SPEC              override the fault script (replica_*
+                                  kinds; steps count router monitor polls
+                                  FROM WORKLOAD START — the bench offsets
+                                  past the warmup's polls)
+      BENCH_CHAOS_FLEET_REQUESTS  total requests per run (default 16)
+      BENCH_CHAOS_FLEET_REPLICAS  fleet size (default 2)
+      BENCH_CHAOS_FLEET_GENLEN_MIX  per-request max-new caps (default "3,8")
+    """
+    import copy
+
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.config_parsing import get_serve_cfg
+    from pytorch_distributed_training_tpu.engine import fault
+    from pytorch_distributed_training_tpu.serving import ServingFleet
+
+    n_requests = int(os.environ.get("BENCH_CHAOS_FLEET_REQUESTS", "16"))
+    n_replicas = int(os.environ.get("BENCH_CHAOS_FLEET_REPLICAS", "2"))
+    genlen_mix = [
+        int(g)
+        for g in os.environ.get("BENCH_CHAOS_FLEET_GENLEN_MIX", "3,8").split(",")
+        if g.strip()
+    ]
+    spec = os.environ.get(fault.ENV_VAR) or "replica_down@2:0"
+    base_cfg = get_serve_cfg(
+        os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+    )
+    base_cfg["serving"]["scheduler"] = {
+        "enabled": True, "slots": 4, "block_size": 4, "num_blocks": 64,
+        "prefix_cache": True,
+    }
+    base_cfg["serving"]["resilience"] = {
+        "max_restarts": 2, "poison_bisect": True, "drain_deadline_ms": 60_000,
+    }
+    base_cfg["serving"]["fleet"] = {
+        "replicas": n_replicas,
+        "affinity": True,
+        # staleness detection stays on but generous: THIS bench's kill is
+        # the injected hard one, and a cold replica mid-compile must not
+        # trip the external detector first
+        "heartbeat_timeout_s": 30.0,
+        "poll_interval_s": 0.02,
+    }
+
+    def offset_spec(raw, base):
+        # fault steps are router-poll indices; the monitor polls through
+        # warmup too, so shift the script past the polls already spent
+        out = []
+        for entry in raw.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, rest = entry.split("@", 1)
+            parts = rest.split(":", 1)
+            shifted = f"{kind}@{int(parts[0]) + base}"
+            if len(parts) > 1:
+                shifted += f":{parts[1]}"
+            out.append(shifted)
+        return ";".join(out)
+
+    def run(temperature, inject):
+        cfg = copy.deepcopy(base_cfg)
+        cfg["serving"]["temperature"] = temperature
+        rng = np.random.default_rng(0)
+        vocab = cfg["dataset"]["n_classes"]
+        fault.reset_counters()
+        fleet = ServingFleet.from_config(cfg)
+        try:
+            seq_max = fleet.replicas[0].seq_buckets[-1]
+            for rep in fleet.replicas:  # compile outside the chaos window
+                rep.submit(
+                    rng.integers(2, vocab, seq_max // 2).astype(np.int32)
+                ).result(timeout=600)
+            if inject:
+                fault.install(offset_spec(spec, fleet.router._poll_no))
+            futures = []
+            for i in range(n_requests):
+                ln = int(rng.integers(1, seq_max + 1))
+                prompt = rng.integers(2, vocab, ln).astype(np.int32)
+                cap = min(
+                    genlen_mix[i % len(genlen_mix)],
+                    fleet.replicas[0].max_new_tokens,
+                )
+                futures.append(fleet.submit(prompt, max_new_tokens=cap))
+            streams = [
+                tuple(int(t) for t in f.result(timeout=600)["tokens"])
+                for f in futures
+            ]
+            counters = dict(fault.counters())
+        finally:
+            fault.install(None)
+            fleet.close()
+        return streams, counters
+
+    report = {}
+    counters = {}
+    for label, temp in (("greedy", 0.0), ("sampled", 1.0)):
+        twin, _ = run(temp, inject=False)
+        killed, counters = run(temp, inject=True)
+        report[label] = {
+            "identical": killed == twin,
+            "completed": len(killed),
+            "failovers": counters.get("serving_fleet_failovers", 0),
+            "replicas_down": counters.get("serving_fleet_replicas_down", 0),
+        }
+    all_identical = all(r["identical"] for r in report.values())
+    print(
+        json.dumps(
+            {
+                "metric": f"chaos-fleet token identity ({n_requests} reqs, "
+                f"kill 1/{n_replicas} replicas mid-stream, greedy+sampled)",
+                "value": int(all_identical),
+                "unit": "all_streams_bitwise_identical",
+                "vs_baseline": None,
+                "greedy": report["greedy"],
+                "sampled": report["sampled"],
+                "parity_mismatches": counters.get(
+                    "serving_fleet_parity_mismatch", 0
+                ) + counters.get("replay_parity_mismatch", 0),
+                **counters,
+            }
+        )
+    )
+
+
+def bench_fleet_serve():
+    """Fleet-serve A/B: router+fleet vs N independent replicas.
+
+    The same shared-prefix workload (G groups of requests whose prompts
+    share their leading tokens) runs twice at the same replica count:
+    once through the :class:`FleetRouter` (prefix-affinity + least-loaded
+    placement), once round-robin over independent engines — the
+    fleet-less baseline.  Affinity routes each prefix group to ONE
+    replica, so its content-addressed prefix cache hits instead of every
+    replica paying its own cold miss (bench Round 7 measured a 0
+    hit-rate on i.i.d. streams).  One JSON line: client-observed p50/p99
+    for both arms, prefix-cache hit rates, aggregate throughput.
+
+      BENCH_FLEET_REPLICAS   replica count for BOTH arms (default 2)
+      BENCH_FLEET_GROUPS     prefix groups (default 8)
+      BENCH_FLEET_GROUP_SIZE requests per group (default 8)
+      BENCH_FLEET_PREFIX_LEN shared-prefix tokens per group (default 12)
+    """
+    import copy
+
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.config_parsing import get_serve_cfg
+    from pytorch_distributed_training_tpu.engine import fault
+    from pytorch_distributed_training_tpu.serving import (
+        InferenceEngine,
+        ServingFleet,
+    )
+
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+    n_groups = int(os.environ.get("BENCH_FLEET_GROUPS", "8"))
+    group_size = int(os.environ.get("BENCH_FLEET_GROUP_SIZE", "8"))
+    prefix_len = int(os.environ.get("BENCH_FLEET_PREFIX_LEN", "12"))
+    cfg = get_serve_cfg(
+        os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+    )
+    cfg["serving"]["scheduler"] = {
+        "enabled": True, "slots": 4, "block_size": 4, "num_blocks": 64,
+        "prefix_cache": True,
+    }
+    cfg["serving"]["fleet"] = {
+        "replicas": n_replicas,
+        "affinity": True,
+        "heartbeat_timeout_s": 30.0,
+        "poll_interval_s": 0.05,
+    }
+    vocab = cfg["dataset"]["n_classes"]
+    rng = np.random.default_rng(7)
+    seq_max = max(int(s) for s in cfg["serving"]["seq_buckets"])
+    suffix_len = min(4, max(1, seq_max - prefix_len))
+    prompts = []
+    for g in range(n_groups):
+        shared = rng.integers(2, vocab, prefix_len).astype(np.int32)
+        for _ in range(group_size):
+            suffix = rng.integers(2, vocab, suffix_len).astype(np.int32)
+            prompts.append(np.concatenate([shared, suffix]))
+    order = rng.permutation(len(prompts))  # interleave the groups
+
+    def drive(submit, replicas):
+        # warm every replica's compiles outside the measured window
+        warm = rng.integers(2, vocab, seq_max // 2).astype(np.int32)
+        for rep in replicas:
+            rep.submit(warm).result(timeout=600)
+        lat = {}
+        futures = []
+        t_start = time.perf_counter()
+        for k in order:
+            t0 = time.perf_counter()
+            fut = submit(int(k), prompts[k])
+            fut.add_done_callback(
+                lambda f, t0=t0, k=k: lat.__setitem__(
+                    int(k), (time.perf_counter() - t0) * 1000.0
+                )
+            )
+            futures.append(fut)
+        for fut in futures:
+            fut.result(timeout=600)
+        wall_s = time.perf_counter() - t_start
+        vals = np.array(sorted(lat.values()))
+        return {
+            "p50": float(np.percentile(vals, 50)),
+            "p99": float(np.percentile(vals, 99)),
+            "reqs_per_sec": len(prompts) / wall_s,
+        }
+
+    # arm A: router + fleet
+    fault.reset_counters()
+    fleet = ServingFleet.from_config(copy.deepcopy(cfg))
+    try:
+        a = drive(lambda k, p: fleet.submit(p), fleet.replicas)
+        snap = fleet.snapshot()
+        a["prefix_hit_rate"] = round(
+            float(snap["fleet"].get("prefix_hit_rate", 0.0)), 3
+        )
+        a["affinity_hits"] = fault.counters().get(
+            "serving_fleet_affinity_hits", 0
+        )
+    finally:
+        fleet.close()
+
+    # arm B: same replica count, no router — round-robin placement
+    fault.reset_counters()
+    model, params, batch_stats, mesh, kwargs = InferenceEngine.resolve_config(
+        copy.deepcopy(cfg)
+    )
+    engines = []
+    for i in range(n_replicas):
+        kw = dict(kwargs)
+        kw.update(replica_id=i)
+        engines.append(InferenceEngine(model, params, batch_stats, mesh, **kw))
+    try:
+        b = drive(lambda k, p: engines[k % n_replicas].submit(p), engines)
+        hits = misses = 0
+        for e in engines:
+            s = e.metrics.snapshot()
+            hits += s.get("prefix_hit_blocks", 0)
+            misses += s.get("prefix_miss_blocks", 0)
+        b["prefix_hit_rate"] = round(
+            float(hits / (hits + misses)) if hits + misses else 0.0, 3
+        )
+    finally:
+        for e in engines:
+            e.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"fleet-serve p99 vs {n_replicas} independent "
+                f"replicas ({n_groups}x{group_size} shared-prefix reqs)",
+                "value": round(a["p99"], 2),
+                "unit": "ms",
+                "vs_baseline": round(b["p99"], 2),
+                "fleet": {k: round(v, 3) if isinstance(v, float) else v
+                          for k, v in a.items()},
+                "independent": {k: round(v, 3) if isinstance(v, float) else v
+                                for k, v in b.items()},
+                "p99_ratio": round(a["p99"] / b["p99"], 3) if b["p99"] else None,
             }
         )
     )
@@ -1958,7 +2246,8 @@ if __name__ == "__main__":
     # lint never executes JAX, so the cache would be pure startup cost
     if mode not in (
         "chaos", "--chaos", "chaos-serve", "--chaos-serve",
-        "chaos-integrity", "--chaos-integrity", "lint"
+        "chaos-integrity", "--chaos-integrity",
+        "chaos-fleet", "--chaos-fleet", "lint"
     ) or os.environ.get("BENCH_COMPILE_CACHE"):
         _enable_compile_cache()
     if mode == "lint":
@@ -1987,6 +2276,10 @@ if __name__ == "__main__":
         bench_chaos_serve()
     elif mode in ("chaos-integrity", "--chaos-integrity"):
         bench_chaos_integrity()
+    elif mode in ("chaos-fleet", "--chaos-fleet"):
+        bench_chaos_fleet()
+    elif mode in ("fleet-serve", "--fleet-serve"):
+        bench_fleet_serve()
     elif mode == "accuracy":
         # Converged-accuracy parity (round-3 VERDICT #1): train ResNet-18
         # through this framework's compiled step AND through a torch

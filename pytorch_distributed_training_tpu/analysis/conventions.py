@@ -48,6 +48,8 @@ BENCH_DRIVERS = (
     "bench_chaos_serve(",
     "bench_chaos_integrity(",
     "bench_overlap(",
+    "bench_chaos_fleet(",
+    "bench_fleet_serve(",
 )
 
 FAULT_MACHINERY = (

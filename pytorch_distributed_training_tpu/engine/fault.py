@@ -66,8 +66,23 @@ tick):
                        watchdog must fire and convert the stall into a
                        diagnosed restart
 
+Fleet-side kinds (the ``step`` is the fleet router's monitor POLL index,
+1-based — serving/router.py consults the injector once per health sweep):
+
+    replica_down@P[:R] hard-kill replica R (default 0) at router poll P:
+                       its in-flight requests fail with
+                       ``ReplicaDownError`` and the router must fail them
+                       over to a survivor with token-identical replay
+    replica_hang@P[:SEC]
+                       wedge replica 0's scheduler thread for SEC
+                       (default 1.0) seconds at router poll P — no Python
+                       progress, so only the heartbeat-staleness check
+                       can see it; the router must mark the replica
+                       unhealthy and hedge/fail over around it
+
 Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/
-``sdc_flip``/``ckpt_corrupt``/the ``serve_*`` family) are one-shot:
+``sdc_flip``/``ckpt_corrupt``/the ``serve_*`` and ``replica_*``
+families) are one-shot:
 consumed when they fire, so a rollback replay of the same step index does
 not re-trip them (the recovery itself must converge).
 
@@ -106,6 +121,7 @@ _STEP_KINDS = (
     "nan_batch", "kill_worker", "stall_step", "kill_peer",
     "sdc_flip", "ckpt_corrupt",
     "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
+    "replica_down", "replica_hang",
 )
 _POINT_KINDS = {
     "ckpt_fail": "ckpt_save",
@@ -170,14 +186,17 @@ class FaultInjector:
                 )
             self._fail_windows.setdefault(_POINT_KINDS[kind], []).append((step, n))
         elif kind in _STEP_KINDS:
-            if kind in ("kill_worker", "serve_nan", "serve_raise", "sdc_flip"):
+            if kind in (
+                "kill_worker", "serve_nan", "serve_raise", "sdc_flip",
+                "replica_down",
+            ):
                 # arg = worker index / scheduler slot index / replica rank
-                # (default 0)
+                # / fleet replica index (default 0)
                 val = float(int(arg)) if arg is not None else 0.0
             elif kind == "kill_peer":
                 # arg = target process index; -1 = whichever rank parses it
                 val = float(int(arg)) if arg is not None else -1.0
-            elif kind in ("stall_step", "serve_hang"):
+            elif kind in ("stall_step", "serve_hang", "replica_hang"):
                 val = float(arg) if arg is not None else 1.0
             else:  # nan_batch / serve_device_lost / ckpt_corrupt take no arg
                 if arg is not None:

@@ -17,6 +17,12 @@ lever — saturate the accelerator by batching — to inference:
   - :mod:`.resilience` — :class:`ServingSupervisor`: poison-bisect
     request isolation, bounded hot-restart with token-identical replay,
     drain/health lifecycle.
+  - :mod:`.router`   — :class:`FleetRouter`: health-gated, prefix-affine
+    placement over N replicas; replica failover with token-identical
+    replay, hedged re-dispatch, fleet backpressure.
+  - :mod:`.fleet`    — :class:`ServingFleet`: replica lifecycle (one
+    checkpoint restore, N engines), concurrent drain, SIGTERM handler,
+    aggregate health/metrics.
 
 ``python -m pytorch_distributed_training_tpu.serving --config
 config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
@@ -24,14 +30,16 @@ config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
 from .batcher import DynamicBatcher
 from .decode import build_generate_fn, build_paged_fns
 from .engine import InferenceEngine
+from .fleet import ServingFleet
 from .kv_pool import BlockAllocator, PagedKVPool
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, aggregate_snapshots
 from .resilience import (
     EngineRestartError,
     HungTickError,
     PoisonedRequestError,
     ServingSupervisor,
 )
+from .router import FleetDownError, FleetRouter, ReplicaDownError
 from .scheduler import ContinuousScheduler
 
 __all__ = [
@@ -39,12 +47,17 @@ __all__ = [
     "ContinuousScheduler",
     "DynamicBatcher",
     "EngineRestartError",
+    "FleetDownError",
+    "FleetRouter",
     "HungTickError",
     "InferenceEngine",
     "PagedKVPool",
     "PoisonedRequestError",
+    "ReplicaDownError",
+    "ServingFleet",
     "ServingMetrics",
     "ServingSupervisor",
+    "aggregate_snapshots",
     "build_generate_fn",
     "build_paged_fns",
 ]

@@ -81,6 +81,10 @@ class InferenceEngine:
         scheduler: Optional[Dict[str, Any]] = None,
         resilience: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
+        replica_id: Optional[int] = None,
+        heartbeat_path: Optional[str] = None,
+        heartbeat_interval_s: float = 0.5,
+        liveness_timeout_s: Optional[float] = None,
     ):
         self.model = model
         self.mesh = mesh
@@ -88,7 +92,12 @@ class InferenceEngine:
         self.max_new_tokens = max_new_tokens
         self.image_size = image_size
         self.logger = logger or logging.getLogger(__name__)
-        self.metrics = ServingMetrics()
+        # fleet identity (serving/fleet.py): namespaces this engine's
+        # process-registry mirror and names its heartbeat file; None =
+        # the historical single-replica engine, byte-identical behavior
+        self.replica_id = replica_id
+        self.heartbeat_path = heartbeat_path
+        self.metrics = ServingMetrics(replica_id)
         n_data = mesh.shape[DATA_AXIS]
         self.batch_buckets = sorted({_round_up(b, n_data) for b in batch_buckets})
         self.seq_buckets = sorted(set(int(s) for s in seq_buckets))
@@ -153,6 +162,10 @@ class InferenceEngine:
                 pool_sharding=rep,
                 resilience=resilience,
                 logger=self.logger,
+                replica_id=replica_id,
+                heartbeat_path=heartbeat_path,
+                heartbeat_interval_s=heartbeat_interval_s,
+                liveness_timeout_s=liveness_timeout_s,
             )
             if sched_cfg:
                 raise ValueError(
@@ -180,6 +193,21 @@ class InferenceEngine:
     @classmethod
     def from_config(cls, cfg: Dict[str, Any], logger=None) -> "InferenceEngine":
         """Build from a ``serve-*.yml`` config (see config_parsing)."""
+        model, params, batch_stats, mesh, kwargs = cls.resolve_config(
+            cfg, logger
+        )
+        return cls(model, params, batch_stats, mesh, **kwargs)
+
+    @classmethod
+    def resolve_config(cls, cfg: Dict[str, Any], logger=None):
+        """Resolve a ``serve-*.yml`` config into constructor ingredients.
+
+        Returns ``(model, params, batch_stats, mesh, kwargs)`` so callers
+        that build SEVERAL engines from one checkpoint (the serving fleet
+        — N replicas share one restored parameter tree and one mesh) pay
+        the restore/init exactly once and stamp each replica's identity
+        into a copy of ``kwargs``.
+        """
         logger = logger or logging.getLogger(__name__)
         serve = cfg["serving"]
         dtype_name = serve.get("dtype", "bfloat16")
@@ -222,11 +250,7 @@ class InferenceEngine:
             from ..data.datasets import IMAGENET_MEAN, IMAGENET_STD
 
             input_norm = (IMAGENET_MEAN, IMAGENET_STD)
-        return cls(
-            model,
-            params,
-            batch_stats,
-            mesh,
+        kwargs = dict(
             is_lm=is_lm,
             batch_buckets=serve.get("batch_buckets", [max_batch]),
             seq_buckets=serve.get("seq_buckets", [16]),
@@ -250,6 +274,7 @@ class InferenceEngine:
             resilience=serve.get("resilience"),
             logger=logger,
         )
+        return model, params, batch_stats, mesh, kwargs
 
     # ------------------------------------------------------------------ #
 
@@ -260,6 +285,7 @@ class InferenceEngine:
         max_new_tokens: Optional[int] = None,
         on_token=None,
         rng=None,
+        replay_tokens=None,
     ):
         """Validate + enqueue one request; returns its result future.
 
@@ -295,10 +321,11 @@ class InferenceEngine:
                 return self.scheduler.submit(
                     prompt, deadline_ms=deadline_ms,
                     max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
+                    replay_tokens=replay_tokens,
                 )
-            if on_token is not None or rng is not None:
+            if on_token is not None or rng is not None or replay_tokens:
                 raise ValueError(
-                    "on_token / per-request rng require "
+                    "on_token / per-request rng / replay_tokens require "
                     "serving.scheduler.enabled (the batcher path samples "
                     "whole batches and resolves futures only at the end)"
                 )
@@ -306,8 +333,13 @@ class InferenceEngine:
                 prompt, deadline_ms=deadline_ms,
                 max_new=(int(max_new_tokens) if max_new_tokens else None),
             )
-        if max_new_tokens is not None or on_token is not None or rng is not None:
-            raise ValueError("max_new_tokens/on_token/rng are LM-only")
+        if (
+            max_new_tokens is not None or on_token is not None
+            or rng is not None or replay_tokens
+        ):
+            raise ValueError(
+                "max_new_tokens/on_token/rng/replay_tokens are LM-only"
+            )
         img = np.asarray(payload)
         want = (self.image_size, self.image_size, 3)
         if img.shape != want:

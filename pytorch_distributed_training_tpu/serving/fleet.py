@@ -1,0 +1,239 @@
+"""N serving replicas + one router = a fleet that survives replica loss.
+
+:class:`ServingFleet` owns the replica lifecycle the router deliberately
+does not: it builds N :class:`.engine.InferenceEngine` replicas from ONE
+config resolution (``InferenceEngine.resolve_config`` restores the
+checkpoint once; every replica shares the parameter tree and mesh, and
+compiles its own decode programs), stamps each with its fleet identity
+(``replica_id`` for metric namespacing, a per-replica heartbeat file for
+external liveness), fronts them with a :class:`.router.FleetRouter`, and
+provides the fleet-wide lifecycle verbs — concurrent ``drain``, SIGTERM
+via ``install_drain_handler``, aggregate ``health()``/``snapshot()``.
+
+Config (``serving.fleet`` in serve-lm.yml)::
+
+    serving:
+      scheduler: {enabled: true, ...}     # fleet requires the scheduler path
+      fleet:
+        replicas: 2                # engine replicas in this process
+        affinity: true             # prefix-sticky placement
+        hedge_ms: 200              # straggler re-dispatch (null = off)
+        max_backlog: 64            # fleet-level shed threshold (null = off)
+        heartbeat_dir: /tmp/hb     # default: a fresh temp dir
+        heartbeat_interval_s: 0.25
+        heartbeat_timeout_s: 2.0   # router marks staler replicas down
+        liveness_timeout_s: 5.0    # in-process health() stall clock
+
+Single-process by design, matching the scheduler: the fleet is N slot
+arrays + N pools in one process, which is exactly the shape the chaos
+harness needs to kill and revive replicas deterministically.  Splitting
+replicas across processes changes only who writes the heartbeat files.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from .engine import InferenceEngine
+from .metrics import aggregate_snapshots
+from .router import FleetRouter
+
+__all__ = ["ServingFleet"]
+
+
+class ServingFleet:
+    """Replica lifecycle + fleet-level verbs over a :class:`FleetRouter`."""
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        router: FleetRouter,
+        heartbeat_dir: Optional[str] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if not replicas:
+            raise ValueError("ServingFleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.router = router
+        self.heartbeat_dir = heartbeat_dir
+        self.logger = logger or logging.getLogger("pdt.serving.fleet")
+        self._closed = False
+        self._close_lock = threading.Lock()
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any], logger=None) -> "ServingFleet":
+        """Build N replicas from one ``serve-*.yml`` resolution.
+
+        The checkpoint restore / random init happens ONCE; each replica
+        gets a copy of the constructor kwargs with its ``replica_id``,
+        heartbeat file, and liveness clock stamped in.
+        """
+        logger = logger or logging.getLogger(__name__)
+        serve = cfg["serving"]
+        fleet_cfg = dict(serve.get("fleet") or {})
+        n = int(fleet_cfg.pop("replicas", 2))
+        if n < 1:
+            raise ValueError(f"serving.fleet.replicas must be >= 1, got {n}")
+        affinity = bool(fleet_cfg.pop("affinity", True))
+        hedge_ms = fleet_cfg.pop("hedge_ms", None)
+        max_backlog = fleet_cfg.pop("max_backlog", None)
+        heartbeat_dir = fleet_cfg.pop("heartbeat_dir", None)
+        hb_interval = float(fleet_cfg.pop("heartbeat_interval_s", 0.25))
+        hb_timeout = fleet_cfg.pop("heartbeat_timeout_s", 2.0)
+        liveness = fleet_cfg.pop("liveness_timeout_s", None)
+        poll_s = float(fleet_cfg.pop("poll_interval_s", 0.05))
+        if fleet_cfg:
+            raise ValueError(
+                f"unknown serving.fleet keys: {sorted(fleet_cfg)}"
+            )
+        model, params, batch_stats, mesh, kwargs = (
+            InferenceEngine.resolve_config(cfg, logger)
+        )
+        sched_cfg = kwargs.get("scheduler") or {}
+        if not kwargs.get("is_lm") or not sched_cfg.get("enabled"):
+            raise ValueError(
+                "serving.fleet requires an LM with serving.scheduler.enabled "
+                "(failover replays token streams through the continuous "
+                "scheduler; the batcher path cannot continue a request)"
+            )
+        if heartbeat_dir is None:
+            heartbeat_dir = tempfile.mkdtemp(prefix="pdt-fleet-hb-")
+        os.makedirs(heartbeat_dir, exist_ok=True)
+        replicas = []
+        for i in range(n):
+            kw = dict(kwargs)
+            kw.update(
+                replica_id=i,
+                heartbeat_path=os.path.join(
+                    heartbeat_dir, f"replica_{i}.json"),
+                heartbeat_interval_s=hb_interval,
+                liveness_timeout_s=liveness,
+            )
+            replicas.append(
+                InferenceEngine(model, params, batch_stats, mesh, **kw))
+        router = FleetRouter(
+            replicas,
+            seed=int(serve.get("seed", 0)),
+            affinity=affinity,
+            max_backlog=(int(max_backlog) if max_backlog is not None else None),
+            hedge_ms=(float(hedge_ms) if hedge_ms is not None else None),
+            heartbeat_timeout_s=(
+                float(hb_timeout) if hb_timeout is not None else None),
+            poll_interval_s=poll_s,
+            logger=logger,
+        )
+        logger.info(
+            "serving fleet up: %d replica(s), affinity=%s, hedge_ms=%s, "
+            "heartbeats in %s", n, affinity, hedge_ms, heartbeat_dir)
+        return cls(replicas, router, heartbeat_dir=heartbeat_dir,
+                   logger=logger)
+
+    # ------------------------------------------------------------------ #
+    # client verbs (router passthrough)
+
+    def submit(
+        self,
+        prompt,
+        deadline_ms: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        rng=None,
+    ) -> Future:
+        return self.router.submit(
+            prompt, deadline_ms=deadline_ms, max_new_tokens=max_new_tokens,
+            on_token=on_token, rng=rng,
+        )
+
+    def depth(self) -> int:
+        return self.router.depth()
+
+    def health(self) -> Dict[str, Any]:
+        return self.router.health()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet metrics: the per-replica sub-snapshots plus the
+        cross-replica aggregate (sums for throughput counters, maxes for
+        tail percentiles — see :func:`.metrics.aggregate_snapshots`)."""
+        per = {
+            f"r{i}": rep.metrics.snapshot()
+            for i, rep in enumerate(self.replicas)
+            if hasattr(rep, "metrics")
+        }
+        return {"fleet": aggregate_snapshots(per), "replicas": per}
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+
+    def drain(self, deadline_ms: Optional[float] = None) -> float:
+        """Graceful fleet shutdown: refuse new submits at the router,
+        drain every replica CONCURRENTLY (each bounds itself with
+        ``deadline_ms``; serial drains would stack the deadlines), then
+        stop the router's monitor.  Returns wall ms spent.  Idempotent;
+        safe from any thread."""
+        t0 = time.monotonic()
+        with self._close_lock:
+            if self._closed:
+                return 0.0
+            self._closed = True
+        self.router.stop_submissions()
+        threads = [
+            threading.Thread(
+                target=rep.drain, args=(deadline_ms,),
+                name=f"fleet-drain-{i}", daemon=True,
+            )
+            for i, rep in enumerate(self.replicas)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.router.shutdown()
+        ms = (time.monotonic() - t0) * 1000.0
+        self.logger.info("fleet drained in %.1f ms", ms)
+        return ms
+
+    def install_drain_handler(self, signum=None) -> None:
+        """Route SIGTERM (or ``signum``) to a graceful fleet drain.
+
+        Same contract as the engine's handler: the signal handler only
+        spawns a daemon thread — drain joins scheduler threads, which a
+        handler must not do inline.  Call from the main thread."""
+        import signal
+
+        signum = signal.SIGTERM if signum is None else signum
+
+        def _handler(sig, frame):
+            self.logger.warning(
+                "signal %s received — draining serving fleet", sig)
+            threading.Thread(
+                target=self.drain, name="fleet-drain", daemon=True
+            ).start()
+
+        signal.signal(signum, _handler)
+
+    def close(self) -> None:
+        """Hard stop: router first (so nothing re-dispatches into a
+        closing replica), then every replica."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.router.shutdown()
+        for rep in self.replicas:
+            try:
+                rep.close()
+            except Exception:
+                self.logger.exception("replica close failed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

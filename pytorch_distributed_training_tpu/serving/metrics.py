@@ -17,6 +17,14 @@ byte-stable for short runs and the existing tests).
 Instruments live in a PRIVATE :class:`MetricsRegistry` (not the process
 one): each engine owns its counts, and two engines in one process must
 not share a ledger.
+
+Fleet mode (PR 12): N replicas in one process each mirror their counters
+into the PROCESS-global registry too (``ContinuousScheduler._bump``),
+which used to collide on the shared ``serving_*`` names.  A
+:class:`ServingMetrics` constructed with ``replica_id`` namespaces that
+mirror (``serving_r<id>_*`` via :meth:`global_name`), and
+:func:`aggregate_snapshots` folds the per-replica sub-snapshots into one
+fleet view for ``ServingFleet.snapshot()``.
 """
 from __future__ import annotations
 
@@ -26,7 +34,7 @@ from typing import Dict, List, Optional
 
 from ..telemetry.registry import MetricsRegistry
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "aggregate_snapshots"]
 
 # reservoir per distribution: big enough that p99 of a uniform sample is a
 # tight estimate, small enough to cap memory at a few KB per engine
@@ -36,7 +44,8 @@ _RESERVOIR = 2048
 class ServingMetrics:
     """Thread-safe accumulator; ``record_batch`` runs on the flush thread."""
 
-    def __init__(self):
+    def __init__(self, replica_id: Optional[int] = None):
+        self.replica_id = replica_id
         self._lock = threading.Lock()
         self._registry = MetricsRegistry()
         self._latency_ms = self._registry.histogram("latency_ms", _RESERVOIR)
@@ -63,6 +72,18 @@ class ServingMetrics:
     def incr(self, name: str, n: int = 1) -> None:
         """Bump a named degradation counter (e.g. ``timeouts``, ``sheds``)."""
         self._registry.counter(name).inc(n)
+
+    def global_name(self, name: str) -> str:
+        """The PROCESS-registry mirror name for a serving instrument.
+
+        Replica-less engines keep the historical flat ``serving_<name>``
+        namespace (every existing test and bench reads it); a fleet
+        replica gets ``serving_r<id>_<name>`` so N replicas in one
+        process stop colliding in the shared ledger.
+        """
+        if self.replica_id is None:
+            return f"serving_{name}"
+        return f"serving_r{self.replica_id}_{name}"
 
     def record_batch(
         self,
@@ -239,3 +260,55 @@ class ServingMetrics:
         )
         logger.info("%s metrics: %s", prefix, parts)
         return snap
+
+
+# --------------------------------------------------------------------- #
+# fleet aggregation
+
+# additive fields: exact under summation (counts and token totals; every
+# counter key not otherwise classified is summed too)
+_AGG_SUM = ("requests", "batches", "items", "gen_tokens")
+# distribution fields where the fleet view takes the worst replica: a
+# percentile of merged samples cannot be recovered from per-replica
+# percentiles, but the MAX is a valid (and operationally honest) bound
+_AGG_MAX = (
+    "latency_ms_p50", "latency_ms_p99", "max_queue_depth",
+    "block_util_max",
+)
+
+
+def aggregate_snapshots(
+    snapshots: Dict[str, Dict[str, float]]
+) -> Dict[str, float]:
+    """Fold per-replica :meth:`ServingMetrics.snapshot` dicts into one
+    fleet view.
+
+    Counts/token totals sum exactly; rates (``items_per_sec``,
+    ``*_tokens_per_sec``) sum because the replicas serve concurrently;
+    latency percentiles take the max across replicas (a bound, labeled as
+    such by keeping the per-replica snapshots alongside); the prefix-cache
+    hit rate is recomputed from the summed hit/miss block counters rather
+    than averaged.  ``health_*``/gauge-like fields are per-replica state
+    and are left to the sub-snapshots.
+    """
+    out: Dict[str, float] = {"replicas": len(snapshots)}
+    sums: Dict[str, float] = {}
+    maxes: Dict[str, float] = {}
+    for snap in snapshots.values():
+        for key, val in snap.items():
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                continue
+            if key in _AGG_MAX:
+                maxes[key] = max(maxes.get(key, val), val)
+            elif key.endswith("_per_sec") or key in _AGG_SUM or (
+                not key.startswith("health_")
+                and not key.endswith(("_mean", "_p50", "_p99", "_rate"))
+            ):
+                sums[key] = sums.get(key, 0) + val
+    out.update(sums)
+    out.update(maxes)
+    hits = sums.get("prefix_hit_blocks", 0)
+    misses = sums.get("prefix_miss_blocks", 0)
+    if hits + misses:
+        out["prefix_hit_rate"] = float(hits / (hits + misses))
+    return out

@@ -1,0 +1,718 @@
+"""Fleet router: health-gated, affinity-aware placement + replica failover.
+
+:class:`FleetRouter` fronts N replicas (each an
+:class:`..serving.engine.InferenceEngine`, or a bare
+:class:`..serving.scheduler.ContinuousScheduler` in tests — the router is
+duck-typed over ``submit``/``health``/``drain``/``close``) and owns four
+fleet-level behaviors no single replica can provide:
+
+**Placement.**  Requests whose prompt shares a prefix-cache key (the
+first full KV block — the same chained-key rule kv_pool.py caches on) are
+routed to the SAME replica via a bounded sticky map, so the
+content-addressed prefix cache actually hits; bench Round 7 measured a
+0 hit-rate on i.i.d. streams precisely because nothing co-located shared
+prefixes.  Everything else goes to the least-loaded healthy replica
+(queue depth + active slots from ``health()``, tie-broken by the
+``block_util`` gauge each replica publishes).
+
+**Health gating.**  A replica is eligible only while ``health()`` says
+ready AND its heartbeat file is fresh.  The heartbeat is written by the
+replica's own scheduler thread (never a side thread — a daemon beater
+would keep beating while the scheduler is wedged in a device call), so a
+stale mtime is evidence no Python progress is being made even when the
+process looks alive from inside: the ElasticCoordinator trick applied to
+serving.
+
+**Failover with token-identical continuation.**  The router records
+every delivered token per request.  When a replica dies (its futures
+fail with a replica-level error, its heartbeat goes stale, or the
+``replica_down``/``replica_hang`` fault kinds fire), in-flight requests
+are re-submitted to a survivor with ``replay_tokens=<delivered>`` and
+the ORIGINAL rng: the survivor re-prefills the prompt, re-derives the
+KV state for the delivered tokens through its own decode program
+(verifying each against the stream — ``replay_parity_mismatch``), and
+continues sampling from the exact per-token fold_in keys the dead
+replica would have used.  ``on_token`` never refires for replayed
+tokens, and the client future resolves with a stream bitwise-equal to an
+unkilled run.
+
+**Hedging + backpressure.**  A request with no token progress for
+``hedge_ms`` gets a duplicate dispatch on another healthy replica with
+first-writer-wins delivery (per-token dedupe against the delivered
+list; disagreement bumps ``serving_fleet_parity_mismatch``).  A fleet
+backlog cap sheds at the router with the batcher's ``OverloadedError``
+before any replica queue saturates.
+
+Lock discipline: all router state is guarded by ``self._lock``.  The
+one ordering rule — NEVER call into a replica (``submit``/``health``/
+``drain``/``hard_kill``; they take the scheduler's condition) while
+holding ``self._lock``: replica done-callbacks can run under that
+condition and take ``self._lock``, so nesting the other way deadlocks.
+Client futures are resolved and ``on_token`` fired outside any replica
+lock; ``on_token`` runs under ``self._lock`` to keep token order (keep
+it cheap, and never call back into the fleet from it).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..engine import fault
+from ..telemetry.registry import get_registry
+from .batcher import OverloadedError
+from .resilience import EngineRestartError
+
+__all__ = ["FleetRouter", "ReplicaDownError", "FleetDownError"]
+
+
+class ReplicaDownError(RuntimeError):
+    """A whole replica is gone (hard-killed, heartbeat stale, or restart
+    budget exhausted).  Replica-level, not request-level: the router
+    fails the affected requests over to a survivor instead of
+    propagating this to clients."""
+
+
+class FleetDownError(RuntimeError):
+    """No healthy replica remains to fail over to; the request cannot
+    complete anywhere."""
+
+
+#: errors that condemn the REPLICA, not the request
+_REPLICA_ERRORS = (ReplicaDownError, EngineRestartError)
+
+
+class _Assignment:
+    """One dispatch of a request onto one replica."""
+
+    __slots__ = ("replica_idx", "next_idx", "removed")
+
+    def __init__(self, replica_idx: int, next_idx: int):
+        self.replica_idx = replica_idx
+        # index into the fleet-level delivered stream this assignment's
+        # NEXT token corresponds to (starts past the replayed prefix)
+        self.next_idx = next_idx  # guarded by: self._lock (router's)
+        self.removed = False  # guarded by: self._lock (router's)
+
+
+class _FleetRequest:
+    """Router-side state for one client request across failovers."""
+
+    __slots__ = (
+        "prompt", "max_new", "deadline_ms", "rng", "on_token", "future",
+        "delivered", "assignments", "affinity_key", "last_progress",
+        "done", "pending_failover", "hedged",
+    )
+
+    def __init__(self, prompt, max_new, deadline_ms, rng, on_token,
+                 affinity_key):
+        self.prompt = prompt  # 1-D np.int32, immutable after submit
+        self.max_new = max_new
+        self.deadline_ms = deadline_ms
+        self.rng = rng  # the ONE sampling key every dispatch reuses
+        self.on_token = on_token
+        self.future: Future = Future()
+        self.delivered: List[int] = []  # guarded by: self._lock (router's)
+        self.assignments: List[_Assignment] = []  # guarded by: self._lock (router's)
+        self.affinity_key = affinity_key
+        self.last_progress = time.monotonic()  # guarded by: self._lock (router's)
+        self.done = False  # guarded by: self._lock (router's)
+        self.pending_failover = False  # guarded by: self._lock (router's)
+        self.hedged = False  # guarded by: self._lock (router's)
+
+
+class FleetRouter:
+    """Health-aware front end over N serving replicas.
+
+    ``submit`` mirrors the single-replica API (prompt / deadline_ms /
+    max_new_tokens / on_token / rng) and returns a Future resolving to
+    the same ``{"tokens", "gen_len"}`` result shape, so a client cannot
+    tell one replica from a fleet — except that replica death no longer
+    fails its requests.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Any],
+        base_rng=None,
+        seed: int = 0,
+        affinity: bool = True,
+        affinity_capacity: int = 256,
+        max_backlog: Optional[int] = None,
+        hedge_ms: Optional[float] = None,
+        heartbeat_timeout_s: Optional[float] = 2.0,
+        poll_interval_s: float = 0.05,
+        start_monitor: bool = True,
+        logger: Optional[logging.Logger] = None,
+    ):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        if max_backlog is not None and max_backlog < 1:
+            raise ValueError(f"max_backlog must be >= 1, got {max_backlog}")
+        if hedge_ms is not None and hedge_ms <= 0:
+            raise ValueError(f"hedge_ms must be > 0, got {hedge_ms}")
+        self.replicas = list(replicas)
+        self.logger = logger or logging.getLogger("pdt.serving.fleet")
+        self.affinity = bool(affinity)
+        self.affinity_capacity = int(affinity_capacity)
+        self.max_backlog = max_backlog
+        self.hedge_ms = hedge_ms
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.poll_interval_s = float(poll_interval_s)
+        if base_rng is None:
+            import jax
+
+            base_rng = jax.random.PRNGKey(seed)
+        self._base_rng = base_rng
+        self._lock = threading.Lock()
+        self._seq_no = 0  # guarded by: self._lock
+        self._outstanding: List[_FleetRequest] = []  # guarded by: self._lock
+        self._down: set = set()  # guarded by: self._lock
+        self._failover_q: deque = deque()  # guarded by: self._lock
+        self._sticky: OrderedDict = OrderedDict()  # guarded by: self._lock
+        self._closed = False  # guarded by: self._lock
+        self._poll_no = 0  # monitor-thread confined
+        self._start_wall = time.time()
+        self._stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        if start_monitor:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor, name="fleet-monitor", daemon=True
+            )
+            self._monitor_thread.start()
+
+    # ------------------------------------------------------------------ #
+    # client side
+
+    def submit(
+        self,
+        prompt,
+        deadline_ms: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        rng=None,
+    ) -> Future:
+        """Route one prompt to a healthy replica; the future survives
+        that replica's death."""
+        import jax
+
+        prompt = np.asarray(prompt, np.int32)
+        healthy = self._healthy()  # replica calls — before taking _lock
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet router is closed")
+            live = len(self.replicas) - len(self._down)
+            if live <= 0:
+                raise FleetDownError("every replica is down")
+            if (
+                self.max_backlog is not None
+                and len(self._outstanding) >= self.max_backlog
+            ):
+                self._bump("sheds")
+                raise OverloadedError(
+                    f"fleet backlog full ({self.max_backlog} outstanding); "
+                    "request shed at the router"
+                )
+            if rng is None:
+                # router-owned keys: replica-independent, so a failover
+                # or hedge resamples the exact same stream anywhere
+                rng = jax.random.fold_in(self._base_rng, self._seq_no)
+            self._seq_no += 1
+            key = self._affinity_key(prompt)
+            freq = _FleetRequest(prompt, max_new_tokens, deadline_ms, rng,
+                                 on_token, key)
+            self._outstanding.append(freq)
+            target = self._place_locked(key, healthy)
+        self._bump("submitted")
+        if target is None:
+            self._fail(freq, OverloadedError(
+                "no healthy replica available for admission"))
+            self._bump("sheds")
+            return freq.future
+        try:
+            self._dispatch(freq, target)
+        except OverloadedError:
+            # replica-side shed: the fleet request dies with it (clients
+            # retry sheds; silently rerouting would hide saturation)
+            with self._lock:
+                freq.done = True
+                self._discard_locked(freq)
+                self._bump_locked("sheds")
+            raise
+        return freq.future
+
+    def depth(self) -> int:
+        """Requests accepted by the router and not yet resolved."""
+        with self._lock:
+            return len(self._outstanding)
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet health: per-replica snapshots + aggregate gates."""
+        snaps = []
+        for idx, rep in enumerate(self.replicas):
+            with self._lock:
+                down = idx in self._down
+            snap = {"replica": idx, "routed_down": down}
+            try:
+                snap.update(rep.health())
+            except Exception as e:  # a dead replica must not hide the rest
+                snap.update(ready=False, live=False, error=str(e))
+            snap["heartbeat_stale"] = self._is_stale(rep)
+            snaps.append(snap)
+        usable = [
+            s for s in snaps
+            if s["ready"] and not s["routed_down"] and not s["heartbeat_stale"]
+        ]
+        with self._lock:
+            outstanding = len(self._outstanding)
+            closed = self._closed
+        return {
+            "ready": bool(usable) and not closed,
+            "live": any(
+                s["live"] and not s["routed_down"] for s in snaps
+            ),
+            "healthy_replicas": len(usable),
+            "replicas": snaps,
+            "outstanding": outstanding,
+        }
+
+    def stop_submissions(self) -> None:
+        """Refuse new submits (drain step 1); in-flight work continues."""
+        with self._lock:
+            self._closed = True
+
+    def shutdown(self) -> None:
+        """Stop the monitor thread.  Does NOT touch the replicas — the
+        fleet owns their lifecycle (drain wants them alive until their
+        queues empty)."""
+        with self._lock:
+            self._closed = True
+        self._stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join()
+            self._monitor_thread = None
+
+    # ------------------------------------------------------------------ #
+    # placement
+
+    def _affinity_key(self, prompt: np.ndarray) -> Optional[Tuple[int, ...]]:
+        """The prefix-cache identity of this prompt: its first full KV
+        block (kv_pool caches ``(len(prompt)-1)//block_size`` blocks, so
+        a prompt contributes/hits the cache iff that is >= 1)."""
+        if not self.affinity:
+            return None
+        bs = self._block_size()
+        if bs is None or (int(prompt.size) - 1) // bs < 1:
+            return None
+        return tuple(int(t) for t in prompt[:bs])
+
+    def _block_size(self) -> Optional[int]:
+        sched = self._sched_of(0)
+        return getattr(sched, "_block_size", None) if sched is not None else None
+
+    def _sched_of(self, idx: int):
+        """The replica's scheduler (engines wrap one; tests pass it bare)."""
+        rep = self.replicas[idx]
+        sched = getattr(rep, "scheduler", None)
+        if sched is not None:
+            return sched
+        return rep if hasattr(rep, "hard_kill") else None
+
+    def _healthy(self) -> List[Tuple[int, Dict[str, Any]]]:
+        """(idx, health snapshot) for every admissible replica.  Calls
+        into replicas — never under ``self._lock``."""
+        with self._lock:
+            down = set(self._down)
+            closed = self._closed
+        if closed:
+            return []
+        out = []
+        for idx, rep in enumerate(self.replicas):
+            if idx in down:
+                continue
+            try:
+                snap = rep.health()
+            except Exception:
+                continue
+            if not snap.get("ready"):
+                continue
+            if self._is_stale(rep):
+                continue
+            out.append((idx, snap))
+        return out
+
+    def _load_score(self, idx: int, snap: Dict[str, Any]) -> Tuple[float, float]:
+        depth = float(snap.get("queue_depth", 0) + snap.get("active_slots", 0))
+        sched = self._sched_of(idx)
+        util = 0.0
+        if sched is not None and hasattr(sched, "metrics"):
+            util = get_registry().gauge(
+                sched.metrics.global_name("block_util")).value
+        return (depth, util)
+
+    def _place_locked(
+        self,
+        key: Optional[Tuple[int, ...]],
+        healthy: List[Tuple[int, Dict[str, Any]]],
+    ) -> Optional[int]:
+        """Pick a replica: sticky-by-prefix first, else least-loaded."""
+        if not healthy:
+            return None
+        healthy_idx = {idx for idx, _ in healthy}
+        if key is not None:
+            cached = self._sticky.get(key)
+            if cached is not None and cached in healthy_idx:
+                self._sticky.move_to_end(key)
+                self._bump_locked("affinity_hits")
+                return cached
+        target = min(healthy, key=lambda h: self._load_score(*h))[0]
+        if key is not None:
+            self._sticky[key] = target
+            self._sticky.move_to_end(key)
+            while len(self._sticky) > self.affinity_capacity:
+                self._sticky.popitem(last=False)
+        return target
+
+    # ------------------------------------------------------------------ #
+    # dispatch + delivery
+
+    def _dispatch(self, freq: _FleetRequest, idx: int,
+                  replay: bool = False) -> None:
+        """Submit ``freq`` to replica ``idx``.  Raises what the replica's
+        ``submit`` raises; the caller decides whether that is fatal (a
+        client submit) or retriable (a failover)."""
+        with self._lock:
+            a = _Assignment(idx, len(freq.delivered))
+            freq.assignments.append(a)
+            replay_tokens = list(freq.delivered) if replay else None
+        rep = self.replicas[idx]
+        try:
+            fut = rep.submit(
+                freq.prompt,
+                deadline_ms=freq.deadline_ms,
+                max_new_tokens=freq.max_new,
+                on_token=lambda tok, f=freq, asn=a: self._deliver(f, asn, tok),
+                rng=freq.rng,
+                replay_tokens=replay_tokens,
+            )
+        except BaseException:
+            with self._lock:
+                a.removed = True
+                if a in freq.assignments:
+                    freq.assignments.remove(a)
+            raise
+        fut.add_done_callback(
+            lambda f, fr=freq, asn=a: self._on_assignment_done(fr, asn, f))
+
+    def _deliver(self, freq: _FleetRequest, a: _Assignment, tok: int) -> None:
+        """Streaming token from one assignment: first-writer-wins dedupe
+        against the fleet-level delivered stream.  Runs on the replica's
+        scheduler thread (NOT under its condition)."""
+        cb = None
+        with self._lock:
+            idx = a.next_idx
+            a.next_idx += 1
+            if idx < len(freq.delivered):
+                # a slower twin (hedge, or a woken hung replica) re-emitting
+                # a token the winner already delivered: drop, but verify
+                if freq.delivered[idx] != int(tok):
+                    self._bump_locked("parity_mismatch")
+                    self.logger.error(
+                        "fleet parity mismatch at token %d: replica %d says "
+                        "%d, delivered %d", idx, a.replica_idx, int(tok),
+                        freq.delivered[idx],
+                    )
+                return
+            freq.delivered.append(int(tok))
+            freq.last_progress = time.monotonic()
+            cb = freq.on_token
+            if cb is not None:
+                # under _lock so a hedge twin cannot reorder the stream;
+                # on_token contract: cheap, no fleet re-entry
+                try:
+                    cb(int(tok))
+                except Exception:
+                    self.logger.exception("fleet on_token callback failed")
+
+    def _on_assignment_done(self, freq: _FleetRequest, a: _Assignment,
+                            fut: Future) -> None:
+        """Terminal state of one dispatch.  May run on the replica's
+        scheduler thread while it holds ITS condition (the expiry path) —
+        so this only classifies + enqueues; it never calls into a
+        replica."""
+        exc = fut.exception()
+        if exc is None:
+            self._complete(freq, a, fut.result())
+        elif isinstance(exc, _REPLICA_ERRORS):
+            self._replica_failed(freq, a, exc)
+        else:
+            self._request_failed(freq, a, exc)
+
+    def _complete(self, freq: _FleetRequest, a: _Assignment, result) -> None:
+        with self._lock:
+            if freq.done:
+                return
+            freq.done = True
+            self._discard_locked(freq)
+            toks = [int(t) for t in np.asarray(result["tokens"]).ravel()]
+            if toks[: len(freq.delivered)] != freq.delivered[: len(toks)]:
+                self._bump_locked("parity_mismatch")
+                self.logger.error(
+                    "fleet parity mismatch: winner result %s != delivered %s",
+                    toks[:8], freq.delivered[:8],
+                )
+            self._bump_locked("completed")
+        freq.future.set_result(result)  # outside _lock: client callbacks
+
+    def _fail(self, freq: _FleetRequest, exc: BaseException) -> None:
+        with self._lock:
+            if freq.done:
+                return
+            freq.done = True
+            self._discard_locked(freq)
+        freq.future.set_exception(exc)
+
+    def _discard_locked(self, freq: _FleetRequest) -> None:
+        try:
+            self._outstanding.remove(freq)
+        except ValueError:
+            pass
+
+    def _replica_failed(self, freq: _FleetRequest, a: _Assignment,
+                        exc: BaseException) -> None:
+        """The replica died under this request: mark it down and queue
+        the request for failover (the monitor thread re-dispatches —
+        this callback may hold the dead replica's condition)."""
+        with self._lock:
+            newly_down = a.replica_idx not in self._down
+            if newly_down:
+                self._down.add(a.replica_idx)
+            a.removed = True
+            if a in freq.assignments:
+                freq.assignments.remove(a)
+            queue_it = (
+                not freq.done
+                and not freq.assignments  # a hedge twin is still running
+                and not freq.pending_failover
+            )
+            if queue_it:
+                freq.pending_failover = True
+                self._failover_q.append(freq)
+        if newly_down:
+            self._bump("replicas_down")
+            self.logger.error(
+                "replica %d marked down: %s", a.replica_idx, exc)
+
+    def _request_failed(self, freq: _FleetRequest, a: _Assignment,
+                        exc: BaseException) -> None:
+        """Request-level error (poison, deadline, shed): the request is
+        at fault, not the replica — propagate unless a twin is live."""
+        with self._lock:
+            a.removed = True
+            if a in freq.assignments:
+                freq.assignments.remove(a)
+            if freq.done or freq.assignments:
+                return
+        self._fail(freq, exc)
+
+    # ------------------------------------------------------------------ #
+    # monitor thread: failover, staleness sweep, hedging, fault hooks
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self._poll_once()
+            except Exception:
+                # the monitor IS the fleet's recovery path; it must
+                # survive its own bugs and keep sweeping
+                self.logger.exception("fleet monitor poll failed")
+
+    def _poll_once(self) -> None:
+        self._poll_no += 1
+        self._consult_injector()
+        self._sweep_health()
+        self._drain_failover_q()
+        if self.hedge_ms is not None:
+            self._sweep_hedges()
+
+    def _consult_injector(self) -> None:
+        """``replica_down@P[:R]`` / ``replica_hang@P[:SEC]``, keyed by
+        this monitor's 1-based poll index."""
+        inj = fault.get_injector()
+        if not inj.active:
+            return
+        arg = inj.take("replica_down", self._poll_no)
+        if arg is not None:
+            idx = int(arg)
+            if 0 <= idx < len(self.replicas):
+                fault.bump("injected_replica_downs")
+                self.logger.warning(
+                    "fault injection: replica_down -> replica %d at poll %d",
+                    idx, self._poll_no)
+                sched = self._sched_of(idx)
+                if sched is not None:
+                    sched.hard_kill(ReplicaDownError(
+                        f"injected replica_down at router poll {self._poll_no}"
+                    ))
+        sec = inj.take("replica_hang", self._poll_no)
+        if sec is not None:
+            fault.bump("injected_replica_hangs")
+            self.logger.warning(
+                "fault injection: replica_hang %.2fs -> replica 0 at poll %d",
+                float(sec), self._poll_no)
+            sched = self._sched_of(0)
+            if sched is not None:
+                sched.inject_hang(float(sec))
+
+    def _is_stale(self, rep: Any) -> bool:
+        """Heartbeat-staleness: the replica's scheduler thread has not
+        touched its beat file within the timeout.  Works entirely from
+        the filesystem — the wedged process cannot lie about it."""
+        if self.heartbeat_timeout_s is None:
+            return False
+        path = getattr(rep, "heartbeat_path", None)
+        if not path:
+            return False
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            # not written yet: grace-period from router start, like the
+            # elastic coordinator's startup grace
+            mtime = self._start_wall
+        return (time.time() - mtime) > self.heartbeat_timeout_s
+
+    def _sweep_health(self) -> None:
+        """Mark replicas down on stale heartbeat or dead liveness, and
+        strand-rescue their in-flight requests."""
+        for idx, rep in enumerate(self.replicas):
+            with self._lock:
+                if idx in self._down:
+                    continue
+            stale = self._is_stale(rep)
+            dead = False
+            if not stale:
+                try:
+                    dead = not rep.health()["live"]
+                except Exception:
+                    dead = True
+            if stale or dead:
+                self._mark_down(
+                    idx,
+                    "heartbeat stale" if stale else "liveness probe failed",
+                )
+
+    def _mark_down(self, idx: int, reason: str) -> None:
+        with self._lock:
+            if idx in self._down:
+                return
+            self._down.add(idx)
+            victims = []
+            for freq in self._outstanding:
+                mine = [a for a in freq.assignments if a.replica_idx == idx]
+                for a in mine:
+                    a.removed = True
+                    freq.assignments.remove(a)
+                if (
+                    mine and not freq.done and not freq.assignments
+                    and not freq.pending_failover
+                ):
+                    freq.pending_failover = True
+                    victims.append(freq)
+            self._failover_q.extend(victims)
+        self._bump("replicas_down")
+        self.logger.error("replica %d marked down: %s", idx, reason)
+        sched = self._sched_of(idx)
+        if sched is not None:
+            # fail whatever it still holds (processed at its next tick
+            # boundary if it ever wakes); its done-callbacks will find
+            # pending_failover already set and stay quiet
+            sched.hard_kill(ReplicaDownError(f"router: {reason}"))
+
+    def _drain_failover_q(self) -> None:
+        while True:
+            with self._lock:
+                if not self._failover_q:
+                    return
+                freq = self._failover_q.popleft()
+                if freq.done:
+                    freq.pending_failover = False
+                    continue
+            self._failover(freq)
+
+    def _failover(self, freq: _FleetRequest) -> None:
+        """Re-dispatch onto a survivor with token-identical replay."""
+        healthy = self._healthy()
+        dispatched = False
+        for idx, _snap in sorted(
+            healthy, key=lambda h: self._load_score(*h)
+        ):
+            try:
+                self._dispatch(freq, idx, replay=True)
+                dispatched = True
+                break
+            except Exception as e:
+                self.logger.warning(
+                    "failover dispatch to replica %d refused: %s", idx, e)
+        with self._lock:
+            freq.pending_failover = False
+            if dispatched:
+                freq.last_progress = time.monotonic()
+        if dispatched:
+            self._bump("failovers")
+            self.logger.warning(
+                "failed request over with %d delivered token(s) replayed",
+                len(freq.delivered))
+        else:
+            self._fail(freq, FleetDownError(
+                "no healthy replica left to fail over to"))
+
+    def _sweep_hedges(self) -> None:
+        now = time.monotonic()
+        limit = self.hedge_ms / 1000.0
+        with self._lock:
+            stragglers = [
+                freq for freq in self._outstanding
+                if not freq.done and not freq.hedged
+                and not freq.pending_failover
+                and len(freq.assignments) == 1
+                and (now - freq.last_progress) > limit
+            ]
+            for freq in stragglers:
+                freq.hedged = True
+        for freq in stragglers:
+            self._hedge(freq)
+
+    def _hedge(self, freq: _FleetRequest) -> None:
+        """Duplicate a straggler onto another healthy replica; both keep
+        running and ``_deliver`` picks the first writer per token."""
+        with self._lock:
+            busy = {a.replica_idx for a in freq.assignments}
+        healthy = [(i, s) for i, s in self._healthy() if i not in busy]
+        if not healthy:
+            return
+        idx = min(healthy, key=lambda h: self._load_score(*h))[0]
+        try:
+            self._dispatch(freq, idx, replay=True)
+        except Exception as e:
+            self.logger.warning("hedge dispatch to replica %d refused: %s",
+                                idx, e)
+            return
+        self._bump("hedges")
+        self.logger.warning(
+            "hedged straggler onto replica %d (%d token(s) replayed)",
+            idx, len(freq.delivered))
+
+    # ------------------------------------------------------------------ #
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        get_registry().counter(f"serving_fleet_{name}").inc(n)
+
+    # identical, but callable where self._lock is already held (the
+    # registry has its own lock and never calls back out)
+    _bump_locked = _bump

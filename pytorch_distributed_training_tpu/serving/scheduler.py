@@ -51,7 +51,9 @@ scripted arrival trace replays bit-identically.
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -132,6 +134,10 @@ class ContinuousScheduler:
         resilience: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
         start: bool = True,
+        replica_id: Optional[int] = None,
+        heartbeat_path: Optional[str] = None,
+        heartbeat_interval_s: float = 0.5,
+        liveness_timeout_s: Optional[float] = None,
     ):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -160,7 +166,29 @@ class ContinuousScheduler:
         self.deadline_ms = deadline_ms
         self.max_backlog = max_backlog
         self.logger = logger or logging.getLogger(__name__)
-        self.metrics = metrics or ServingMetrics()
+        self.metrics = metrics or ServingMetrics(replica_id)
+        # fleet identity + external liveness (PR 12, serving/router.py):
+        # the heartbeat file's mtime is this replica's liveness clock for
+        # observers OUTSIDE the process/thread — the scheduler thread
+        # itself touches it (tick + idle wakeups), deliberately not a
+        # side thread, so a wedged scheduler goes stale instead of being
+        # masked by a healthy beater.
+        self.replica_id = replica_id
+        self.heartbeat_path = heartbeat_path
+        if heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"heartbeat_interval_s must be > 0, got {heartbeat_interval_s}"
+            )
+        self._hb_interval = float(heartbeat_interval_s)
+        self._liveness_timeout_s = (
+            float(liveness_timeout_s) if liveness_timeout_s is not None
+            else None
+        )
+        if self._liveness_timeout_s is not None and self._liveness_timeout_s <= 0:
+            raise ValueError(
+                f"liveness_timeout_s must be > 0, got {liveness_timeout_s}"
+            )
+        self._last_beat = 0.0  # scheduler-thread confined (+ constructor)
 
         # kept for hot-restart: _rebuild_and_requeue reconstructs the
         # compiled programs and the pool from the same ingredients
@@ -210,6 +238,18 @@ class ContinuousScheduler:
         self._drain_deadline: Optional[float] = None  # guarded by: self._cond
         self._last_tick: Optional[float] = None  # guarded by: self._cond
         self._hang_info = None  # guarded by: self._cond
+        # fleet kill/hang switches (hard_kill / inject_hang set them from
+        # the router's monitor thread; the scheduler thread processes
+        # them at its next tick boundary so slot/pool mutation stays
+        # thread-confined)
+        self._die_exc: Optional[BaseException] = None  # guarded by: self._cond
+        self._dead = False  # guarded by: self._cond
+        self._hang_sec: Optional[float] = None  # guarded by: self._cond
+        self._tick_started_at: Optional[float] = None  # guarded by: self._cond
+        # prefix-cache block tallies for the registry gauges (tick-thread
+        # reads; _admit writes under the condition it already holds)
+        self._hit_blocks = 0
+        self._miss_blocks = 0
 
         # tick-thread-confined recovery state (supervisor runs inside
         # tick's except clause, on the same thread)
@@ -253,6 +293,7 @@ class ContinuousScheduler:
                 logger=self.logger,
             )
 
+        self._beat(force=True)  # exists-from-birth: no startup-grace races
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -270,6 +311,7 @@ class ContinuousScheduler:
         max_new_tokens: Optional[int] = None,
         on_token: Optional[Callable[[int], None]] = None,
         rng=None,
+        replay_tokens: Optional[Sequence[int]] = None,
     ) -> Future:
         """Enqueue one prompt; the future resolves at retirement.
 
@@ -278,6 +320,16 @@ class ContinuousScheduler:
         dead decode steps — the whole point of iteration-level
         scheduling); ``rng`` overrides the request's sampling key (a
         PRNGKey) so tests can replay the whole-batch path row for row.
+
+        ``replay_tokens`` pre-populates the request's generated stream:
+        admission takes the hot-restart replay path (``_replay``) instead
+        of a fresh prefill, re-deriving the KV state for those tokens
+        through the same decode program and verifying each one against
+        the stream bit-for-bit — WITHOUT refiring ``on_token`` for them.
+        This is how the fleet router fails a half-generated request over
+        from a dead replica to a survivor token-identically; pass the
+        exact ``rng`` the original submission used or the continuation
+        diverges.
         """
         prompt = np.asarray(prompt, np.int32)
         if prompt.ndim != 1 or prompt.size < 1:
@@ -298,6 +350,20 @@ class ContinuousScheduler:
         dl = deadline_ms if deadline_ms is not None else self.deadline_ms
         if dl is not None and dl <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {dl}")
+        replay = [int(t) for t in replay_tokens] if replay_tokens else []
+        if replay:
+            if rng is None:
+                raise ValueError(
+                    "replay_tokens needs the ORIGINAL submission's rng — "
+                    "a fresh key would resample a different stream and "
+                    "every replayed token would flag replay_parity_mismatch"
+                )
+            if len(replay) >= mnt:
+                raise ValueError(
+                    f"replay_tokens ({len(replay)}) must be shorter than "
+                    f"max_new_tokens ({mnt}); a fully-generated request "
+                    "has nothing left to decode"
+                )
         with self._cond:
             if self._closed:
                 raise RuntimeError("scheduler is closed")
@@ -326,6 +392,8 @@ class ContinuousScheduler:
                 deadline=(time.monotonic() + dl / 1000.0) if dl else None,
                 on_token=on_token, row_key=rng,
             )
+            if replay:
+                req.tokens = replay
             self._queue.append(req)
             self.metrics.observe_depth(len(self._queue))
             self._cond.notify_all()
@@ -388,8 +456,12 @@ class ContinuousScheduler:
         """Readiness/liveness snapshot for orchestration probes.
 
         ``ready`` = accepting submissions; ``live`` = worth keeping the
-        process (False once the restart budget is exhausted).  Mirrored
-        into :class:`ServingMetrics` gauges (``health_*``) so one metrics
+        process (False once the restart budget is exhausted, the replica
+        is hard-killed, or — with ``liveness_timeout_s`` set — the
+        scheduler thread has made no Python progress for that long while
+        it HAD work, i.e. it is wedged inside a tick or a device call;
+        idle-with-nothing-to-do never counts as stalled).  Mirrored into
+        :class:`ServingMetrics` gauges (``health_*``) so one metrics
         snapshot carries health alongside latency/throughput.
         """
         now = time.monotonic()
@@ -399,11 +471,24 @@ class ContinuousScheduler:
             closed = self._closed
             draining = self._draining
             last = self._last_tick
+            started = self._tick_started_at
+            dead = self._dead
         restarts = self._supervisor.restarts()
         exhausted = self._supervisor.exhausted()
+        stalled = False
+        if self._liveness_timeout_s is not None:
+            # a tick in progress counts as busy from its START stamp (a
+            # hung device call never updates _last_tick); otherwise only
+            # pending work makes an old tick suspicious — an idle healthy
+            # replica legitimately stops ticking
+            busy = started is not None or depth > 0 or active > 0
+            ref = started if started is not None else last
+            if busy and ref is not None:
+                stalled = (now - ref) > self._liveness_timeout_s
         snap = {
-            "ready": not (closed or draining or exhausted),
-            "live": not exhausted,
+            "ready": not (closed or draining or exhausted or dead or stalled),
+            "live": not (exhausted or dead or stalled),
+            "stalled": stalled,
             "queue_depth": depth,
             "active_slots": active,
             "engine_restarts": restarts,
@@ -414,6 +499,34 @@ class ContinuousScheduler:
         }
         self.metrics.record_health(snap)
         return snap
+
+    def hard_kill(self, exc: BaseException) -> None:
+        """Fleet-level kill switch: fail this whole replica with ``exc``.
+
+        Safe from ANY thread (the fleet router's monitor calls it): only
+        a flag is set here; the scheduler thread processes the death at
+        its next tick boundary, so slot/pool mutation keeps its
+        single-thread contract.  Every queued and in-flight request fails
+        with ``exc`` (the router fails them over to a survivor) and the
+        scheduler closes.  Idempotent; a no-op after a clean close.
+        """
+        with self._cond:
+            if self._closed or self._die_exc is not None:
+                return
+            self._die_exc = exc
+            self._cond.notify_all()
+
+    def inject_hang(self, seconds: float) -> None:
+        """Wedge the scheduler thread for ``seconds`` at its next tick
+        boundary (the ``replica_hang`` fault hook): no Python progress,
+        no heartbeat — only an OUTSIDE observer reading the heartbeat
+        file's age (or ``health()``'s liveness clock) can see it, which
+        is exactly what the router's staleness detection must prove."""
+        with self._cond:
+            if self._closed:
+                return
+            self._hang_sec = float(seconds)
+            self._cond.notify_all()
 
     def close(self) -> None:
         """Drain queue and in-flight slots, then stop the loop."""
@@ -450,6 +563,25 @@ class ContinuousScheduler:
         supervisor, which evicts the poisoned request or hot-restarts —
         the caller never sees the exception unless recovery itself dies.
         """
+        with self._cond:
+            self._tick_started_at = time.monotonic()
+            die = self._die_exc
+            hang, self._hang_sec = self._hang_sec, None
+        if hang is not None:
+            # simulated wedge: sleep BEFORE the heartbeat touch so the
+            # file goes stale exactly like a real stuck device call
+            self.logger.warning(
+                "fault injection: replica scheduler wedged for %.2fs", hang
+            )
+            time.sleep(hang)
+        if die is not None:
+            try:
+                self._die(die)
+            finally:
+                with self._cond:
+                    self._tick_started_at = None
+            return True
+        self._beat()
         self._tick_no += 1
         self._tick_phase = "setup"
         if self._watchdog is not None:
@@ -462,6 +594,7 @@ class ContinuousScheduler:
                     self._watchdog.step_finished()
                 with self._cond:
                     self._last_tick = time.monotonic()
+                    self._tick_started_at = None
             with self._cond:
                 hang, self._hang_info = self._hang_info, None
             if hang is not None and hang[0] == self._tick_no:
@@ -508,13 +641,59 @@ class ContinuousScheduler:
         if n_active:
             self._tick_phase = "decode"
             self._decode_step()
+        self._publish_pool_gauges()
         return bool(newly) or n_active > 0
 
     def _bump(self, name: str, n: int = 1) -> None:
         """Engine-local AND process-global: the snapshot shows the
-        engine's own counts, the telemetry registry the fleet view."""
+        engine's own counts, the telemetry registry the fleet view.  The
+        global mirror is namespaced per replica (``serving_r<id>_*``)
+        when this scheduler has a fleet identity, so N replicas in one
+        process stop colliding on the shared names."""
         self.metrics.incr(name, n)
-        get_registry().counter(f"serving_{name}").inc(n)
+        get_registry().counter(self.metrics.global_name(name)).inc(n)
+
+    def _beat(self, force: bool = False) -> None:
+        """Touch the heartbeat file (throttled to ``heartbeat_interval_s``).
+
+        Atomic tmp + ``os.replace`` against readers, mtime as the clock —
+        the ElasticCoordinator pattern.  Write failures are logged and
+        swallowed: a full disk must not take down serving, it just makes
+        this replica look stale (fail-safe direction)."""
+        if self.heartbeat_path is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._last_beat < self._hb_interval:
+            return
+        self._last_beat = now
+        tmp = self.heartbeat_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "replica_id": self.replica_id,
+                        "pid": os.getpid(),
+                        "tick": self._tick_no,
+                    },
+                    f,
+                )
+            os.replace(tmp, self.heartbeat_path)
+        except OSError:
+            self.logger.exception("heartbeat write failed; continuing")
+
+    def _publish_pool_gauges(self) -> None:
+        """Per-replica pool-state gauges in the PROCESS registry: the
+        router's placement reads these cross-thread (block utilization
+        for load scoring, prefix-hit rate for affinity telemetry), and
+        the serve bench surfaces them in its JSON line."""
+        reg = get_registry()
+        util = self._kv.blocks_in_use / max(self._kv.num_blocks, 1)
+        reg.gauge(self.metrics.global_name("block_util")).set(util)
+        total = self._hit_blocks + self._miss_blocks
+        if total:
+            reg.gauge(self.metrics.global_name("prefix_hit_rate")).set(
+                self._hit_blocks / total
+            )
 
     def _expire(self, req: _PagedRequest, now: float) -> bool:
         if req.deadline is None or now < req.deadline:
@@ -560,6 +739,8 @@ class ContinuousScheduler:
                 newly.append(req)
                 self._bump("admitted")
                 cacheable = (req.prompt.size - 1) // self._kv.block_size
+                self._hit_blocks += adm.n_shared
+                self._miss_blocks += cacheable - adm.n_shared
                 if adm.n_shared:
                     self._bump("prefix_hit_blocks", adm.n_shared)
                 if cacheable - adm.n_shared:
@@ -933,6 +1114,20 @@ class ContinuousScheduler:
         self._bump("requests_poisoned")
         self.logger.error("%s", err)
 
+    def _die(self, exc: BaseException) -> None:
+        """Process a :meth:`hard_kill` on the scheduler thread: fail every
+        queued and in-flight request with the replica-level error and
+        close.  The router's done-callbacks see the error, classify it as
+        replica loss, and fail the requests over to a survivor."""
+        self.logger.error("replica hard-killed: %s", exc)
+        self._bump("replica_down")
+        self._fail_inflight(exc)
+        with self._cond:
+            self._die_exc = None
+            self._dead = True
+            self._closed = True
+            self._cond.notify_all()
+
     def _fail_inflight(self, exc: BaseException) -> None:
         """A device error poisons every in-flight request (their pool
         state is unknown); queued requests are failed too rather than
@@ -1005,10 +1200,21 @@ class ContinuousScheduler:
             with self._cond:
                 while not (
                     self._closed
+                    or self._die_exc is not None
+                    or self._hang_sec is not None
                     or self._queue
                     or any(s is not None for s in self._slots)
                 ):
-                    self._cond.wait()
+                    if self.heartbeat_path is None:
+                        self._cond.wait()
+                    else:
+                        # bounded wait so an IDLE healthy replica keeps
+                        # beating — external staleness must mean "wedged",
+                        # never "merely quiet"
+                        self._cond.wait(
+                            timeout=max(self._hb_interval / 2.0, 0.01)
+                        )
+                        self._beat()
                 if (
                     self._closed
                     and not self._queue

@@ -224,6 +224,8 @@ def test_unknown_fault_kind_names_the_valid_kinds():
     msg = str(ei.value)
     for kind in ("nan_batch", "kill_worker", "stall_step", "kill_peer",
                  "sdc_flip", "ckpt_corrupt",
+                 "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
+                 "replica_down", "replica_hang",
                  "ckpt_fail", "restore_fail", "ckpt_async_fail"):
         assert kind in msg, f"{kind!r} missing from the error menu: {msg}"
 

@@ -1,0 +1,526 @@
+"""Fleet router + replica failover oracles (serving/router.py, fleet.py).
+
+The load-bearing oracle mirrors ISSUE 12's acceptance bar: killing a
+replica mid-stream completes every in-flight request with a token stream
+**bitwise identical** to an unkilled twin run — greedy AND sampled — and
+``on_token`` never refires a token the client already has.  The router
+passes each request's ORIGINAL sampling key to the survivor together
+with ``replay_tokens=<delivered>``, so the continuation resamples the
+exact per-token ``fold_in`` stream the dead replica would have produced;
+``replay_parity_mismatch`` and ``serving_fleet_parity_mismatch`` staying
+at zero proves it token by token.
+
+Determinism: replicas are built with ``start=False`` and ticked by hand,
+and the router with ``start_monitor=False`` so its monitor poll
+(`_poll_once`) is a scripted step too — kill ordering is exact, not a
+race the test hopes to win.
+"""
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.engine import fault
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.serving.batcher import OverloadedError
+from pytorch_distributed_training_tpu.serving.fleet import ServingFleet
+from pytorch_distributed_training_tpu.serving.metrics import (
+    ServingMetrics,
+    aggregate_snapshots,
+)
+from pytorch_distributed_training_tpu.serving.router import (
+    FleetDownError,
+    FleetRouter,
+    ReplicaDownError,
+)
+from pytorch_distributed_training_tpu.serving.scheduler import ContinuousScheduler
+from pytorch_distributed_training_tpu.telemetry.registry import get_registry
+
+VOCAB = 61
+
+
+def small_lm(**kwargs):
+    return TransformerLM(
+        vocab_size=VOCAB, max_len=32, embed_dim=32, depth=2, num_heads=4, **kwargs
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    model = small_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _prompts(seed=3, lens=(6, 5, 7, 6)):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(2, VOCAB, ln).astype(np.int32) for ln in lens]
+
+
+def _mk_replica(model, params, replica_id, **kw):
+    defaults = dict(
+        slots=4, block_size=4, num_blocks=16, batch_buckets=[4],
+        seq_buckets=[8], max_new_tokens=8, temperature=0.0, eos_id=None,
+        prefix_cache=False, start=False, replica_id=replica_id,
+    )
+    defaults.update(kw)
+    return ContinuousScheduler(model, params, **defaults)
+
+
+def _mk_router(replicas, base, **kw):
+    defaults = dict(
+        base_rng=base, heartbeat_timeout_s=None, start_monitor=False,
+    )
+    defaults.update(kw)
+    return FleetRouter(replicas, **defaults)
+
+
+def _twin_streams(model, params, prompts, base, **sched_kw):
+    """What an unkilled single replica produces for the same keys the
+    router hands out (``fold_in(base, submission_ordinal)``)."""
+    sched = _mk_replica(model, params, 9, **sched_kw)
+    futs = [
+        sched.submit(p, rng=jax.random.fold_in(base, i))
+        for i, p in enumerate(prompts)
+    ]
+    n = 0
+    while any(not f.done() for f in futs):
+        sched.tick()
+        n += 1
+        assert n < 300, "twin run did not converge"
+    out = [list(map(int, f.result()["tokens"])) for f in futs]
+    sched.close()
+    return out
+
+
+def _drive(scheds, futs, limit=300):
+    n = 0
+    while any(not f.done() for f in futs):
+        for s in scheds:
+            s.tick()
+        n += 1
+        assert n < limit, "fleet run did not converge"
+
+
+def _placements(router):
+    with router._lock:
+        return {
+            i: [a.replica_idx for a in fr.assignments]
+            for i, fr in enumerate(router._outstanding)
+        }
+
+
+# --------------------------------------------------------------------- #
+# the tentpole oracle: mid-stream replica death, bitwise-equal completion
+
+
+@pytest.mark.parametrize("temperature", [0.0, 1.0])
+def test_failover_token_identity(lm_and_params, temperature):
+    model, params = lm_and_params
+    prompts = _prompts()
+    base = jax.random.PRNGKey(42)
+    fault.reset_counters()
+    expected = _twin_streams(model, params, prompts, base,
+                             temperature=temperature)
+
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0, temperature=temperature)
+    r1 = _mk_replica(model, params, 1, temperature=temperature)
+    router = _mk_router([r0, r1], base)
+    streams = {i: [] for i in range(len(prompts))}
+    futs = [
+        router.submit(p, on_token=lambda t, i=i: streams[i].append(int(t)))
+        for i, p in enumerate(prompts)
+    ]
+    # least-loaded placement alternates over equally-idle replicas, so
+    # both replicas hold in-flight work when one dies
+    placed = _placements(router)
+    assert {idx for a in placed.values() for idx in a} == {0, 1}
+
+    for _ in range(3):  # mid-stream: a few tokens delivered everywhere
+        r0.tick()
+        r1.tick()
+    assert all(0 < len(s) < len(expected[i]) for i, s in streams.items())
+
+    r0.hard_kill(ReplicaDownError("chaos: replica 0 dies mid-stream"))
+    r0.tick()            # scheduler thread processes the death
+    router._poll_once()  # monitor dispatches failovers onto the survivor
+    _drive([r1], futs)
+
+    results = [list(map(int, f.result()["tokens"])) for f in futs]
+    router.shutdown()
+    r1.close()
+    r0.close()
+    assert results == expected
+    # on_token never refired: each stream is exactly the result, in order
+    assert [streams[i] for i in range(len(prompts))] == expected
+    c = fault.counters()
+    assert c.get("serving_fleet_failovers", 0) >= 1
+    assert c.get("serving_fleet_replicas_down") == 1
+    assert c.get("serving_fleet_parity_mismatch", 0) == 0
+    assert c.get("replay_parity_mismatch", 0) == 0
+
+
+def test_replica_down_injector_fires_failover(lm_and_params):
+    """``replica_down@P[:R]`` keys on the router's poll index and kills
+    exactly replica R; the kind-menu grammar drives the same failover
+    path as a real death."""
+    model, params = lm_and_params
+    prompts = _prompts(seed=5, lens=(6, 6))
+    base = jax.random.PRNGKey(7)
+    fault.reset_counters()
+    expected = _twin_streams(model, params, prompts, base)
+
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = _mk_router([r0, r1], base)
+    fault.install("replica_down@2:0")
+    try:
+        futs = [router.submit(p) for p in prompts]
+        r0.tick()
+        r1.tick()
+        router._poll_once()  # poll 1: no fault yet
+        router._poll_once()  # poll 2: hard-kills replica 0
+        r0.tick()            # death processed; failover enqueued
+        router._poll_once()  # poll 3: failover dispatched to replica 1
+        _drive([r1], futs)
+        results = [list(map(int, f.result()["tokens"])) for f in futs]
+    finally:
+        fault.install(None)
+        router.shutdown()
+        r1.close()
+        r0.close()
+    assert results == expected
+    c = fault.counters()
+    assert c.get("injected_replica_downs") == 1
+    assert c.get("serving_fleet_replicas_down") == 1
+    assert c.get("serving_fleet_parity_mismatch", 0) == 0
+
+
+def test_heartbeat_staleness_marks_down_and_fails_over(
+        lm_and_params, tmp_path):
+    """A replica that stops beating (wedged in a device call — no Python
+    progress, so no in-process signal) is detected from OUTSIDE via its
+    heartbeat file's age and its requests fail over."""
+    model, params = lm_and_params
+    prompts = _prompts(seed=11, lens=(6, 6))
+    base = jax.random.PRNGKey(13)
+    fault.reset_counters()
+    expected = _twin_streams(model, params, prompts, base)
+
+    fault.reset_counters()
+    hb = str(tmp_path / "r0.json")
+    r0 = _mk_replica(model, params, 0, heartbeat_path=hb,
+                     heartbeat_interval_s=0.01)
+    r1 = _mk_replica(model, params, 1)
+    # warm both replicas so the timed phase below measures ticks, not
+    # first-call XLA compiles (a cold prefill takes longer than the
+    # staleness budget and would trip the detector "early")
+    for rep in (r0, r1):
+        w = rep.submit(np.array([3, 4, 5, 6, 7], np.int32))
+        _drive([rep], [w])
+        w.result()
+    router = _mk_router([r0, r1], base, heartbeat_timeout_s=0.2)
+    r0.tick()  # fresh beat (r1's warmup compile aged the last one)
+    futs = [router.submit(p) for p in prompts]
+    assert {a for p in _placements(router).values() for a in p} == {0, 1}
+    r0.tick()  # generates a little
+    r1.tick()
+    router._poll_once()
+    assert not router.health()["replicas"][0]["heartbeat_stale"]
+    # r0 now wedges: no more ticks, no more beats
+    time.sleep(0.3)
+    assert router._is_stale(r0)
+    router._poll_once()  # staleness sweep marks it down + fails over
+    _drive([r1], futs)
+    results = [list(map(int, f.result()["tokens"])) for f in futs]
+    health = router.health()
+    router.shutdown()
+    r1.close()
+    r0.close()
+    assert results == expected
+    assert health["replicas"][0]["routed_down"] is True
+    assert health["ready"] is True  # the survivor keeps the fleet up
+    c = fault.counters()
+    assert c.get("serving_fleet_replicas_down") == 1
+    assert c.get("serving_fleet_failovers", 0) >= 1
+    assert c.get("serving_fleet_parity_mismatch", 0) == 0
+
+
+@pytest.mark.chaos
+def test_serve_hang_liveness_from_heartbeat_age(lm_and_params, tmp_path):
+    """Satellite regression: ``health()`` reports liveness from the
+    wall-clock age of the last tick/beat, so a replica hung INSIDE a
+    tick (``serve_hang`` — the thread is in time.sleep, exactly like a
+    wedged device call) goes ``live: False`` while hung and recovers
+    after."""
+    model, params = lm_and_params
+    fault.reset_counters()
+    sched = _mk_replica(
+        model, params, 0, start=True,
+        heartbeat_path=str(tmp_path / "hb.json"),
+        heartbeat_interval_s=0.02, liveness_timeout_s=0.25,
+    )
+    try:
+        sched.submit(np.array([3, 4, 5, 6, 7], np.int32)).result(timeout=120)
+        assert sched.health()["live"] is True
+        # the tick counter kept running through the warmup; wedge the
+        # SECOND tick from now (the first admits, so the hang catches the
+        # request mid-decode)
+        fault.install(f"serve_hang@{sched._tick_no + 2}:1.2")
+        fut = sched.submit(np.array([7, 6, 5, 4, 3], np.int32))
+        deadline = time.monotonic() + 5.0
+        saw_stalled = False
+        while time.monotonic() < deadline:
+            h = sched.health()
+            if h["stalled"]:
+                saw_stalled = True
+                assert h["live"] is False and h["ready"] is False
+                break
+            time.sleep(0.02)
+        assert saw_stalled, "liveness never flipped during the hang"
+        # the hang ends; the request completes and liveness recovers
+        fut.result(timeout=30)
+        assert sched.health()["live"] is True
+    finally:
+        fault.install(None)
+        sched.close()
+    assert fault.counters().get("injected_serve_hangs") == 1
+
+
+def test_fleet_fault_kinds_parse_and_are_one_shot():
+    """Grammar pin for the fleet kinds: ``replica_down`` takes a replica
+    index (default 0), ``replica_hang`` takes seconds (default 1.0), and
+    both are one-shot like the rest of the ``serve_*`` family."""
+    inj = fault.FaultInjector(
+        "replica_down@3:1;replica_hang@2:0.5;replica_down@7"
+    )
+    assert inj.take("replica_hang", 2) == 0.5
+    assert inj.take("replica_down", 3) == 1.0
+    assert inj.take("replica_down", 3) is None  # one-shot
+    assert inj.take("replica_down", 7) == 0.0  # default replica index
+    inj2 = fault.FaultInjector("replica_hang@4")
+    assert inj2.take("replica_hang", 4) == 1.0  # default seconds
+
+
+# --------------------------------------------------------------------- #
+# placement
+
+
+def test_affinity_routes_shared_prefix_to_one_replica(lm_and_params):
+    """Requests sharing their first KV block land on ONE replica, and
+    that replica's prefix cache actually hits (the gauge the satellite
+    exports goes positive)."""
+    model, params = lm_and_params
+    base = jax.random.PRNGKey(21)
+    fault.reset_counters()
+    get_registry().gauge("serving_r0_prefix_hit_rate").set(0.0)
+    get_registry().gauge("serving_r1_prefix_hit_rate").set(0.0)
+    r0 = _mk_replica(model, params, 0, prefix_cache=True)
+    r1 = _mk_replica(model, params, 1, prefix_cache=True)
+    router = _mk_router([r0, r1], base)
+    shared = np.array([9, 8, 7, 6], np.int32)  # one full block
+    group = [np.concatenate([shared, [i + 2, i + 3]]).astype(np.int32)
+             for i in range(3)]
+    # the first group member populates the owner's prefix cache...
+    first = router.submit(group[0])
+    owners = {a for assigned in _placements(router).values() for a in assigned}
+    assert len(owners) == 1
+    owner = owners.pop()
+    _drive([r0, r1], [first])
+    first.result()
+    # ...and the rest stick to the same replica and HIT that cache
+    futs = [router.submit(p) for p in group[1:]]
+    placed = _placements(router)
+    assert all(a == [owner] for a in placed.values()), placed
+    _drive([r0, r1], futs)
+    for f in futs:
+        f.result()
+    hit_rate = get_registry().gauge(f"serving_r{owner}_prefix_hit_rate").value
+    router.shutdown()
+    r0.close()
+    r1.close()
+    assert hit_rate > 0.0
+    assert fault.counters().get("serving_fleet_affinity_hits", 0) >= 2
+
+
+def test_placement_skips_down_replica_and_fleet_down(lm_and_params):
+    model, params = lm_and_params
+    base = jax.random.PRNGKey(23)
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = _mk_router([r0, r1], base)
+    r0.hard_kill(ReplicaDownError("dead"))
+    r0.tick()
+    router._poll_once()  # liveness sweep routes replica 0 out
+    futs = [router.submit(p) for p in _prompts(seed=31, lens=(6, 6))]
+    placed = _placements(router)
+    assert all(a == [1] for a in placed.values()), placed
+    _drive([r1], futs)
+    for f in futs:
+        f.result()
+    # the whole fleet down -> submit fails loudly, not silently queued
+    r1.hard_kill(ReplicaDownError("dead too"))
+    r1.tick()
+    router._poll_once()
+    with pytest.raises(FleetDownError):
+        router.submit(np.array([2, 3, 4, 5, 6], np.int32))
+    router.shutdown()
+    r0.close()
+    r1.close()
+
+
+def test_fleet_backpressure_sheds_at_router(lm_and_params):
+    model, params = lm_and_params
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    router = _mk_router([r0], jax.random.PRNGKey(1), max_backlog=2)
+    p = np.array([2, 3, 4, 5, 6], np.int32)
+    futs = [router.submit(p) for _ in range(2)]
+    with pytest.raises(OverloadedError):
+        router.submit(p)
+    _drive([r0], futs)
+    for f in futs:
+        f.result()
+    router.shutdown()
+    r0.close()
+    assert fault.counters().get("serving_fleet_sheds") == 1
+
+
+# --------------------------------------------------------------------- #
+# hedging
+
+
+def test_hedge_first_writer_wins(lm_and_params):
+    """A straggling request gets a duplicate dispatch; both replicas
+    deliver, the per-token dedupe keeps the stream single and ordered,
+    and the result matches the unhedged twin bitwise."""
+    model, params = lm_and_params
+    prompts = _prompts(seed=17, lens=(6,))
+    base = jax.random.PRNGKey(19)
+    fault.reset_counters()
+    expected = _twin_streams(model, params, prompts, base,
+                             temperature=1.0)
+
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0, temperature=1.0)
+    r1 = _mk_replica(model, params, 1, temperature=1.0)
+    router = _mk_router([r0, r1], base, hedge_ms=50.0)
+    stream = []
+    fut = router.submit(prompts[0], on_token=lambda t: stream.append(int(t)))
+    r0.tick()
+    r0.tick()  # partial progress on the primary...
+    with router._lock:
+        freq = router._outstanding[0]
+        freq.last_progress -= 10.0  # ...then it stalls (simulated)
+    router._poll_once()
+    with router._lock:
+        assert len(freq.assignments) == 2, "hedge was not dispatched"
+    # BOTH replicas race the remainder; every token index is delivered
+    # exactly once, first writer wins
+    _drive([r0, r1], [fut])
+    result = list(map(int, fut.result()["tokens"]))
+    router.shutdown()
+    r0.close()
+    r1.close()
+    assert result == expected[0]
+    assert stream == expected[0]
+    c = fault.counters()
+    assert c.get("serving_fleet_hedges") == 1
+    assert c.get("serving_fleet_parity_mismatch", 0) == 0
+    assert c.get("replay_parity_mismatch", 0) == 0
+
+
+# --------------------------------------------------------------------- #
+# fleet lifecycle
+
+
+def test_fleet_drain_concurrent_and_late_submit_raises(lm_and_params):
+    model, params = lm_and_params
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = _mk_router([r0, r1], jax.random.PRNGKey(2))
+    fleet = ServingFleet([r0, r1], router)
+    futs = [fleet.submit(p) for p in _prompts(seed=37, lens=(6, 5, 7, 6))]
+    ms = fleet.drain(deadline_ms=30_000)
+    assert ms >= 0.0
+    # drain finished the in-flight work rather than failing it
+    for f in futs:
+        assert len(f.result(timeout=1)["tokens"]) > 0
+    for rep in (r0, r1):
+        assert rep.health()["closed"] is True
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(np.array([2, 3, 4, 5, 6], np.int32))
+    assert fleet.drain() == 0.0  # idempotent
+    fleet.close()
+
+
+def test_fleet_sigterm_routes_to_drain(lm_and_params):
+    model, params = lm_and_params
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    router = _mk_router([r0], jax.random.PRNGKey(3))
+    fleet = ServingFleet([r0], router)
+    fut = fleet.submit(np.array([5, 6, 7, 8, 9], np.int32))
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        fleet.install_drain_handler()
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler) and handler is not prev
+        handler(signal.SIGTERM, None)  # what the kernel would deliver
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not r0.health()["closed"]:
+            time.sleep(0.01)
+        assert r0.health()["closed"] is True
+        assert len(fut.result(timeout=1)["tokens"]) > 0
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# metrics namespacing + aggregation (satellite)
+
+
+def test_metrics_namespacing_and_fleet_aggregate(lm_and_params):
+    assert ServingMetrics(3).global_name("sheds") == "serving_r3_sheds"
+    assert ServingMetrics().global_name("sheds") == "serving_sheds"
+
+    model, params = lm_and_params
+    fault.reset_counters()
+    base = jax.random.PRNGKey(29)
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = _mk_router([r0, r1], base)
+    fleet = ServingFleet([r0, r1], router)
+    futs = [fleet.submit(p) for p in _prompts(seed=41, lens=(6, 6))]
+    _drive([r0, r1], futs)
+    for f in futs:
+        f.result()
+    snap = fleet.snapshot()
+    fleet.close()
+    assert set(snap["replicas"]) == {"r0", "r1"}
+    agg = snap["fleet"]
+    assert agg["replicas"] == 2
+    # per-replica request counters SUM across the fleet
+    assert agg["requests"] == (
+        snap["replicas"]["r0"]["requests"] + snap["replicas"]["r1"]["requests"]
+    )
+    # tail latency takes the MAX (a fleet p99 is no better than its
+    # worst replica)
+    assert agg["latency_ms_p99"] == max(
+        snap["replicas"]["r0"]["latency_ms_p99"],
+        snap["replicas"]["r1"]["latency_ms_p99"],
+    )
+    # namespaced counters landed in the shared registry without colliding
+    c = fault.counters()
+    assert c.get("serving_r0_retired", 0) >= 1
+    assert c.get("serving_r1_retired", 0) >= 1

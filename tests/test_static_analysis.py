@@ -477,3 +477,9 @@ def test_serving_recovery_state_is_lock_annotated():
     sched = (PKG / "serving" / "scheduler.py").read_text()
     # queue/close/drain/hang state all ride the scheduler condition
     assert sched.count("# guarded by: self._cond") >= 5
+    # the fleet router's shared state (outstanding requests, down-set,
+    # failover queue, sticky map) rides the router lock — and the
+    # declarations are what lets the lock-discipline pass police every
+    # submit/deliver/failover path against it
+    router = (PKG / "serving" / "router.py").read_text()
+    assert router.count("# guarded by: self._lock") >= 6
