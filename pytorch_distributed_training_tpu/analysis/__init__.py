@@ -1,5 +1,6 @@
 """pdt-analyze: static analysis for trace purity, lock discipline,
-collective order, donation safety, and repo conventions.
+collective order, donation safety, repo conventions, inferred-lockset
+thread safety, resource lifecycles, and the generated config schema.
 
 The analyzer itself is stdlib-only and never executes the code it
 inspects (a purity checker that imported its targets would trigger the
@@ -20,6 +21,7 @@ from pathlib import Path
 from typing import Optional, Sequence
 
 from .collectives import CollectiveOrderPass, extract_collective_sequences
+from .configschema import ConfigSchemaPass, extract_schema, schema_as_json
 from .conventions import MarkerConventionPass
 from .core import (
     AnalysisContext,
@@ -33,9 +35,11 @@ from .core import (
     write_baseline,
 )
 from .donation import DonationSafetyPass
+from .lifecycle import ResourceLifecyclePass
 from .locks import LockDisciplinePass
 from .purity import TracePurityPass
 from .report import json_payload, render_json, render_text
+from .threads import ThreadSafetyPass
 
 __all__ = [
     "ALL_PASSES",
@@ -46,11 +50,13 @@ __all__ = [
     "SourceModule",
     "collect_modules",
     "extract_collective_sequences",
+    "extract_schema",
     "json_payload",
     "load_baseline",
     "render_json",
     "render_text",
     "run",
+    "schema_as_json",
     "write_baseline",
 ]
 
@@ -61,6 +67,9 @@ ALL_PASSES = (
     CollectiveOrderPass,
     DonationSafetyPass,
     MarkerConventionPass,
+    ThreadSafetyPass,
+    ResourceLifecyclePass,
+    ConfigSchemaPass,
 )
 
 
@@ -74,6 +83,7 @@ def run(
     rules: Optional[Sequence[str]] = None,
     baseline: Optional[Path] = None,
     tests_dir: Optional[Path] = None,
+    config_dir: Optional[Path] = None,
 ) -> AnalysisResult:
     """Run the selected passes (default: all) over ``package_root``."""
     if package_root is None:
@@ -85,6 +95,8 @@ def run(
         )
     if tests_dir is not None:
         ctx.tests_dir = Path(tests_dir)
+    if config_dir is not None:
+        ctx.config_dir = Path(config_dir)
     passes = [cls() for cls in ALL_PASSES]
     if rules is not None:
         wanted = set(rules)

@@ -12,28 +12,34 @@ Examples::
     python -m pytorch_distributed_training_tpu.analysis \
         --write-baseline .pdt-baseline.json
     python -m pytorch_distributed_training_tpu.analysis --collectives
+    python -m pytorch_distributed_training_tpu.analysis --schema
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
 from . import (
     ALL_PASSES,
     extract_collective_sequences,
+    extract_schema,
     render_json,
     render_text,
     run,
+    schema_as_json,
     write_baseline,
 )
+from .core import collect_modules
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="pdt-analyze",
         description="static analysis: trace purity, lock discipline, "
-        "collective order, donation safety, repo conventions",
+        "collective order, donation safety, repo conventions, inferred-"
+        "lockset thread safety, resource lifecycles, config schema",
     )
     parser.add_argument(
         "--root",
@@ -67,7 +73,19 @@ def main(argv=None) -> int:
         action="store_true",
         help="print the per-family collective-order extraction and exit",
     )
+    parser.add_argument(
+        "--schema",
+        action="store_true",
+        help="print the generated config schema (accepted keys, types, "
+        "defaults per section) as JSON and exit",
+    )
     args = parser.parse_args(argv)
+
+    if args.schema:
+        root = args.root or Path(__file__).resolve().parent.parent
+        modules = collect_modules(Path(root), Path(root).parent)
+        print(json.dumps(schema_as_json(extract_schema(modules)), indent=2))
+        return 0
 
     if args.collectives:
         root = args.root or Path(__file__).resolve().parent.parent

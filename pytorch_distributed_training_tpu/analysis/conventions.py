@@ -17,6 +17,12 @@ from the CLI, ``bench.py lint``, and the tier-1 gate.  Three sub-rules:
     ``telemetry/registry.py``; assigning ``self._counters = {}`` (or a
     ``Counter()``/``defaultdict()``) anywhere else in the package
     reintroduces a private ledger the goodput snapshot cannot see.
+  - **pass-registration**: every ``AnalysisPass`` subclass defined under
+    ``analysis/`` must appear in the ``ALL_PASSES`` tuple in
+    ``analysis/__init__.py``.  A pass that exists but is not registered
+    silently runs nowhere — not in the CLI, not in ``bench.py lint``,
+    not in the tier-1 gate — which is exactly the failure mode a lint
+    framework must refuse to allow for itself.
 
 The tests scan covers ``tests/test_*.py``; the counter scan covers the
 package tree minus ``telemetry/`` (the one place ledgers may live) and
@@ -115,6 +121,7 @@ class MarkerConventionPass(AnalysisPass):
         findings: List[Finding] = []
         findings.extend(self._check_tests(ctx))
         findings.extend(self._check_counter_stores(modules))
+        findings.extend(self._check_pass_registration(modules))
         return findings
 
     # ------------------------------------------------------------------ #
@@ -174,6 +181,57 @@ class MarkerConventionPass(AnalysisPass):
                             ),
                         )
                     )
+        return findings
+
+    def _check_pass_registration(self, modules: Sequence[SourceModule]) -> List[Finding]:
+        """Every AnalysisPass subclass under analysis/ is in ALL_PASSES."""
+        findings: List[Finding] = []
+        defined = []  # (class name, module, lineno)
+        registered = None  # names in the ALL_PASSES tuple, if found
+        for module in modules:
+            parts = module.rel.split("/")
+            if "analysis" not in parts:
+                continue
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef) and any(
+                    (isinstance(b, ast.Name) and b.id == "AnalysisPass")
+                    or (isinstance(b, ast.Attribute) and b.attr == "AnalysisPass")
+                    for b in node.bases
+                ):
+                    defined.append((node.name, module, node.lineno))
+                if (
+                    module.path.name == "__init__.py"
+                    and isinstance(node, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "ALL_PASSES"
+                        for t in node.targets
+                    )
+                    and isinstance(node.value, ast.Tuple)
+                ):
+                    registered = {
+                        e.id if isinstance(e, ast.Name) else getattr(e, "attr", "")
+                        for e in node.value.elts
+                    }
+        if registered is None:
+            # No ALL_PASSES tuple in scope (e.g. a fixture subset) — the
+            # pin only bites when the registry itself is being analyzed.
+            return findings
+        for name, module, lineno in defined:
+            if name not in registered:
+                findings.append(
+                    Finding(
+                        rule=self.rule,
+                        severity=SEVERITY_ERROR,
+                        path=module.rel,
+                        line=lineno,
+                        message=(
+                            f"{name} subclasses AnalysisPass but is missing "
+                            "from ALL_PASSES in analysis/__init__.py — an "
+                            "unregistered pass runs nowhere (CLI, bench.py "
+                            "lint, tier-1 gate all iterate ALL_PASSES)"
+                        ),
+                    )
+                )
         return findings
 
     def _check_counter_stores(self, modules: Sequence[SourceModule]) -> List[Finding]:

@@ -113,9 +113,13 @@ class AnalysisContext:
     package_root: Path  # the pytorch_distributed_training_tpu/ dir
     repo_root: Path  # its parent (where tests/ and bench.py live)
     tests_dir: Optional[Path] = None  # overridable for fixture tests
+    config_dir: Optional[Path] = None  # overridable for fixture tests
 
     def resolved_tests_dir(self) -> Path:
         return self.tests_dir if self.tests_dir is not None else self.repo_root / "tests"
+
+    def resolved_config_dir(self) -> Path:
+        return self.config_dir if self.config_dir is not None else self.repo_root / "config"
 
 
 class AnalysisPass:

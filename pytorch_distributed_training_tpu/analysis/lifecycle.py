@@ -1,0 +1,257 @@
+"""resource-lifecycle: acquire/release tracking on every outgoing path.
+
+The serving stack leans on four resource patterns whose leaks the PR 9/12
+audits could only catch dynamically (the KV-block leak audit inside the
+scheduler tick, the in-flight-future sweep in the chaos harness).  This
+pass makes the function-local cases static guarantees:
+
+  ===============================  ==================================
+  acquire                          release
+  ===============================  ==================================
+  ``f = Future()``                 ``f.set_result/set_exception/cancel``
+  ``t = Thread(...)`` (non-daemon) ``t.join()``
+  ``fh = open(...)``               ``fh.close()``
+  ``blocks = pool.alloc/admit(..)``handed to a call (``free``/escape)
+  ===============================  ==================================
+
+Two findings per resource kind:
+
+  - **definite leak** — the name never reaches a release call and never
+    escapes the function (not returned/yielded, not passed to any call,
+    not stored into an attribute/subscript/container, not aliased).  An
+    escaping resource transfers ownership; tracking it further would need
+    whole-program alias analysis and would drown the report in maybes.
+  - **leak on exception edge** — a release exists, but statements between
+    the acquire and the release contain calls that may raise, and the
+    release is not protected by a ``finally`` (or reached via ``with``).
+    This is exactly the shape of the in-flight-future bug class: admit a
+    request, run model code that can throw, only then resolve the future.
+
+Deliberate scope cuts, each matching a real idiom in the tree:
+
+  - ``with open(...)`` is already safe and not tracked.
+  - ``self._file = open(...)`` (telemetry sinks/spans) stores ownership
+    in the object; object-lifetime pairing is the thread-safety /
+    close-method contract, not a function-local property.
+  - ``daemon=True`` threads are exempt from the join requirement:
+    ``elastic.guard`` deliberately abandons its worker on timeout, and a
+    daemon thread cannot block interpreter exit.  A *non-daemon* thread
+    acquired and never joined is always a bug.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    SourceModule,
+    dotted_name,
+    func_qualname,
+)
+
+__all__ = ["ResourceLifecyclePass"]
+
+# kind -> release method names on the acquired object
+_RELEASES: Dict[str, Tuple[str, ...]] = {
+    "future": ("set_result", "set_exception", "cancel"),
+    "thread": ("join",),
+    "file": ("close",),
+    "blocks": ("free", "release"),  # via escape: passing to pool.free() absolves
+}
+
+_ACQ_CTORS = {"Future": "future", "Thread": "thread"}
+_ACQ_METHODS = {"alloc": "blocks", "admit": "blocks"}
+
+
+def _last_segment(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _classify_acquire(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    seg = _last_segment(name)
+    if name == "open":
+        return "file"
+    if seg in _ACQ_CTORS:
+        if seg == "Thread":
+            for kw in call.keywords:
+                if (
+                    kw.arg == "daemon"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    return None  # daemon threads may be abandoned
+        return _ACQ_CTORS[seg]
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _ACQ_METHODS:
+        return _ACQ_METHODS[call.func.attr]
+    return None
+
+
+class _Resource:
+    __slots__ = ("name", "kind", "line", "stmt")
+
+    def __init__(self, name: str, kind: str, line: int, stmt: ast.stmt):
+        self.name = name
+        self.kind = kind
+        self.line = line
+        self.stmt = stmt
+
+
+class _FunctionAudit:
+    def __init__(self, module: SourceModule, fn: ast.AST, rule: str):
+        self.module = module
+        self.fn = fn
+        self.rule = rule
+
+    def _nodes(self):
+        """All nodes of this function, excluding nested function bodies
+        (a resource captured by a nested def has its lifetime extended in
+        ways function-local analysis cannot pair)."""
+        stack: List[ast.AST] = list(self.fn.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def acquires(self) -> List[_Resource]:
+        out: List[_Resource] = []
+        for node in self._nodes():
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name) or not isinstance(node.value, ast.Call):
+                continue
+            kind = _classify_acquire(node.value)
+            if kind:
+                out.append(_Resource(t.id, kind, node.lineno, node))
+        return out
+
+    # ------------------------------------------------------------ evidence
+
+    def _uses(self, res: _Resource):
+        """(releases, escapes, other_calls) — categorized uses after acquire."""
+        releases: List[ast.Call] = []
+        escapes: List[ast.AST] = []
+        calls: List[ast.Call] = []
+        name = res.name
+        for node in self._nodes():
+            if isinstance(node, ast.Call):
+                calls.append(node)
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == name
+                    and fn.attr in _RELEASES[res.kind]
+                ):
+                    releases.append(node)
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name) and arg.id == name:
+                        escapes.append(node)
+                    elif isinstance(arg, ast.Starred) and (
+                        isinstance(arg.value, ast.Name) and arg.value.id == name
+                    ):
+                        escapes.append(node)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name) and sub.id == name:
+                        escapes.append(node)
+                        break
+            elif isinstance(node, ast.Assign):
+                if node is res.stmt:
+                    continue
+                # stored into attribute/subscript/container, or re-aliased
+                value_names = {
+                    n.id for n in ast.walk(node.value) if isinstance(n, ast.Name)
+                }
+                if name in value_names:
+                    escapes.append(node)
+        return releases, escapes, calls
+
+    def _protected(self, releases: Sequence[ast.Call]) -> bool:
+        """True if some release sits in a finally or except handler."""
+        release_ids = {id(r) for r in releases}
+        for node in self._nodes():
+            if isinstance(node, ast.Try):
+                regions = list(node.finalbody)
+                for h in node.handlers:
+                    regions.extend(h.body)
+                for stmt in regions:
+                    for sub in ast.walk(stmt):
+                        if id(sub) in release_ids:
+                            return True
+        return False
+
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        qual = func_qualname(self.module, self.fn)
+        for res in self.acquires():
+            releases, escapes, calls = self._uses(res)
+            if escapes:
+                continue  # ownership transferred
+            if not releases:
+                out.append(
+                    Finding(
+                        rule=self.rule,
+                        severity=SEVERITY_ERROR,
+                        path=self.module.rel,
+                        line=res.line,
+                        message=(
+                            f"{res.name} ({res.kind}) acquired in {qual} "
+                            f"never reaches "
+                            f"{'/'.join(_RELEASES[res.kind])} and does not "
+                            "escape the function"
+                        ),
+                    )
+                )
+                continue
+            if self._protected(releases):
+                continue
+            first_release = min(r.lineno for r in releases)
+            risky = [
+                c
+                for c in calls
+                if res.line < c.lineno < first_release
+                and c not in releases
+            ]
+            if risky:
+                out.append(
+                    Finding(
+                        rule=self.rule,
+                        severity=SEVERITY_ERROR,
+                        path=self.module.rel,
+                        line=res.line,
+                        message=(
+                            f"{res.name} ({res.kind}) acquired in {qual} can "
+                            f"leak on an exception edge: calls between the "
+                            f"acquire and "
+                            f"{'/'.join(_RELEASES[res.kind])} may raise "
+                            "first — release in a finally block"
+                        ),
+                    )
+                )
+        return out
+
+
+class ResourceLifecyclePass(AnalysisPass):
+    rule = "resource-lifecycle"
+    description = (
+        "futures, threads, file handles and pool allocations must reach "
+        "their release (set_result/join/close/free) or escape on every "
+        "outgoing path, including exception edges"
+    )
+
+    def run(self, modules: Sequence[SourceModule], ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(_FunctionAudit(module, node, self.rule).findings())
+        return findings

@@ -1,0 +1,541 @@
+"""thread-safety: inferred-lockset race detection over concurrency roots.
+
+Where lock-discipline (locks.py) enforces the ``# guarded by:``
+declarations someone remembered to write, this pass INFERS the thread
+structure of every class and flags the shared state nobody declared.
+Since PR 8 the host-side thread surface has roughly tripled (scheduler
+tick threads, router health monitors, per-replica heartbeat writers,
+async checkpoint writers, supervisors); an annotation-only checker is a
+sampled audit, this is the census.
+
+Per class, the pass:
+
+  1. discovers **concurrency roots** — every entry point from which a
+     second thread of control can run a method of the class:
+
+       - ``threading.Thread(target=self.m)`` / ``threading.Timer``
+       - executor handoffs: ``.submit(self.m)`` / ``.submit(lambda: ...)``
+       - completion callbacks: ``.add_done_callback(self.m | lambda)``
+       - signal handlers: ``signal.signal(sig, self.m)`` (async interrupt)
+       - registered callbacks: a bound method or lambda passed as a call
+         argument (``on_retry=self._count_retry``,
+         ``DynamicBatcher(self._run_batch, ...)``) — the callee stores it
+         and may invoke it from any thread it owns
+       - the **api root**: the class's public methods, standing in for
+         "whatever thread the caller is on"
+
+     ``atexit.register`` is exempt (runs on the main thread at interpreter
+     exit, after every daemon thread stops being observable), and
+     ``__init__`` is never a root — construction happens-before
+     publication, so helpers reached only from ``__init__`` contribute
+     nothing.
+
+  2. computes each root's transitively-reached attribute read/write sets
+     through the same scope-chain resolution purity.py uses, tracking the
+     **lockset** held at every access (``with self._lock:`` nesting; a
+     ``# guarded by:`` comment or ``*_locked`` suffix on a ``def`` line
+     seeds the entry lockset, matching lock-discipline's contract).  The
+     api root does not traverse into methods owned by a real root — a
+     ``drain()`` that calls the tick loop's own helper in test mode is
+     the loop's code, not a second mutator.
+
+  3. flags any ``self.*`` attribute written outside ``__init__`` and
+     accessed from >= 2 roots whose locksets share no common lock.  Both
+     PR 8 race shapes (an unlocked ``fires += 1`` from a monitor thread, a
+     lock-free list snapshot from the api while the loop thread mutates)
+     fall out of this one rule, with zero annotations required.
+
+Declarations become verified claims rather than the only signal:
+
+  - ``# guarded by: self._lock`` attributes are skipped here —
+    lock-discipline enforces every access site against the declaration.
+  - ``# confined: <root>`` (new) declares single-writer thread
+    confinement: only methods owned by the named root (a root entry
+    method name, or ``api``) may WRITE the attribute; cross-root reads
+    are the caller's stale-read bargain and stay legal.  The pass
+    verifies the confinement instead of trusting it.
+
+Out of scope, deliberately: synchronization that is not lock-shaped.
+Attributes initialized to ``threading.Event``/``Lock``/``Condition``/
+``Semaphore`` or ``queue.*Queue`` are internally synchronized and
+exempt; cross-CLASS calls are not followed (each class is analyzed
+against its own methods, keeping the pass O(tree) like purity.py); and
+happens-before edges from ``Thread.start``/``join`` are not modeled —
+state handed across such an edge wants a lock or a ``# confined:``
+declaration that makes the ownership legible.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    SEVERITY_ERROR,
+    AnalysisContext,
+    AnalysisPass,
+    Finding,
+    SourceModule,
+    dotted_name,
+)
+from .locks import GUARDED_BY_RE
+
+__all__ = ["ThreadSafetyPass", "CONFINED_RE"]
+
+CONFINED_RE = re.compile(r"#\s*confined:\s*([A-Za-z_]\w*)")
+
+# `self.X = <ctor>()` in __init__ with one of these constructors marks X
+# internally synchronized (or a lock object itself) — exempt from the
+# shared-state analysis.  `deque` is NOT here: its append/popleft are
+# individually atomic but compound read-modify-write sequences are not.
+_SYNC_CTORS = {
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "BoundedSemaphore",
+    "Barrier", "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "local",
+}
+
+# Mutating method calls on a container attribute count as writes to the
+# attribute.  `.set()` is deliberately absent (threading.Event.set — and
+# Events are exempt anyway).
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "remove", "clear", "add", "discard", "update", "setdefault",
+    "sort", "reverse", "move_to_end",
+}
+
+# Callees whose callable arguments do NOT run concurrently with the class.
+_NON_DEFERRED_CALLEES = {"atexit.register", "atexit.unregister"}
+
+_PUBLIC_DUNDERS = {"__call__", "__enter__", "__exit__", "__iter__", "__next__"}
+
+_API = "api"
+
+
+def _last_segment(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> "X", else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _Access:
+    __slots__ = ("attr", "write", "root", "held", "line", "method")
+
+    def __init__(self, attr, write, root, held, line, method):
+        self.attr = attr
+        self.write = write
+        self.root = root  # root label
+        self.held = held  # frozenset of lock names ("*" = all)
+        self.line = line
+        self.method = method
+
+
+class _Root:
+    """One concurrency root: an entry method (or lambda/local def body)."""
+
+    def __init__(self, label: str, entry_name: Optional[str], bodies: List[ast.AST]):
+        self.label = label
+        self.entry_name = entry_name  # method name for named roots
+        self.bodies = bodies  # method defs / lambda nodes to start from
+
+
+class _ClassAudit:
+    def __init__(self, module: SourceModule, cls: ast.ClassDef, rule: str):
+        self.module = module
+        self.cls = cls
+        self.rule = rule
+        self.findings: List[Finding] = []
+        self.methods: Dict[str, ast.AST] = {}
+        for node in cls.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[node.name] = node
+        # class-level aliases: `_bump_locked = _bump`
+        for node in cls.body:
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.methods
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.methods[t.id] = self.methods[node.value.id]
+        self.exempt_attrs = self._collect_exempt()
+        self.guarded_attrs = self._collect_marked(GUARDED_BY_RE)
+        self.confined_attrs = self._collect_marked(CONFINED_RE)
+        self.accesses: List[_Access] = []
+        # methods visited by real (non-api) roots
+        self._real_owned: Set[ast.AST] = set()
+        # entry-method name -> names of methods that root owns
+        self._owned_by: Dict[str, Set[str]] = {}
+        self._visited: Set[Tuple[str, int, frozenset]] = set()
+
+    # ---------------------------------------------------------- declarations
+
+    def _line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.module.lines):
+            return self.module.lines[lineno - 1]
+        return ""
+
+    def _collect_exempt(self) -> Set[str]:
+        out: Set[str] = set()
+        for method in self.methods.values():
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                if _last_segment(dotted_name(node.value.func)) not in _SYNC_CTORS:
+                    continue
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        out.add(attr)
+        return out
+
+    def _collect_marked(self, regex) -> Dict[str, Tuple[str, int]]:
+        """attr -> (marker payload, line) for assignments carrying `regex`."""
+        out: Dict[str, Tuple[str, int]] = {}
+        for method in self.methods.values():
+            for node in ast.walk(method):
+                targets: List[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        m = regex.search(self._line(t.lineno))
+                        if m:
+                            out.setdefault(attr, (m.group(1), t.lineno))
+        return out
+
+    # ----------------------------------------------------------------- roots
+
+    def discover_roots(self) -> List[_Root]:
+        roots: Dict[str, _Root] = {}
+
+        def add_method_root(kind: str, name: str) -> None:
+            # keyed by entry method: one method == one root even when it is
+            # registered several ways (Thread target + generic kwarg scan)
+            if name not in roots:
+                roots[name] = _Root(f"{kind}:{name}", name, [self.methods[name]])
+
+        def add_anon_root(kind: str, where: str, body: ast.AST) -> None:
+            label = f"{kind}:<fn in {where}>"
+            root = roots.setdefault(label, _Root(label, None, []))
+            if body not in root.bodies:
+                root.bodies.append(body)
+
+        for mname, method in self.methods.items():
+            local_defs = {
+                n.name: n
+                for n in ast.walk(method)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not method
+            }
+            for node in ast.walk(method):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func)
+                if callee in _NON_DEFERRED_CALLEES:
+                    continue
+                seg = _last_segment(callee) if callee else (
+                    node.func.attr if isinstance(node.func, ast.Attribute) else ""
+                )
+                deferred_args: List[Tuple[Optional[str], ast.AST]] = []
+                if seg in ("Thread", "Timer"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            deferred_args.append(("thread", kw.value))
+                    if seg == "Timer" and len(node.args) >= 2:
+                        deferred_args.append(("thread", node.args[1]))
+                elif seg == "submit" and node.args:
+                    deferred_args.append(("executor", node.args[0]))
+                elif seg == "add_done_callback" and node.args:
+                    deferred_args.append(("callback", node.args[0]))
+                elif seg == "signal" and len(node.args) >= 2:
+                    deferred_args.append(("signal", node.args[1]))
+                # generic callback registration: bound methods / lambdas
+                # handed to an on_*/…callback kwarg, or positionally to a
+                # constructor (which stores them and calls from its own
+                # threads — DynamicBatcher(self._run_batch, ...)).  A plain
+                # function taking a callable (device_prefetch, retry.call,
+                # elastic.guard) runs it on the caller's own thread.
+                for kw in node.keywords:
+                    if kw.arg and (kw.arg.startswith("on_") or "callback" in kw.arg):
+                        deferred_args.append((None, kw.value))
+                if seg[:1].isupper():
+                    for a in node.args:
+                        deferred_args.append((None, a))
+                for kind, arg in deferred_args:
+                    attr = _self_attr(arg)
+                    if attr and attr in self.methods:
+                        add_method_root(kind or "callback", attr)
+                    elif kind is not None and isinstance(arg, ast.Lambda):
+                        add_anon_root(kind, mname, arg.body)
+                    elif kind == "thread" and isinstance(arg, ast.Name):
+                        target = local_defs.get(arg.id)
+                        if target is not None:
+                            add_anon_root("thread", mname, target)
+                    elif (
+                        kind is None
+                        and isinstance(arg, ast.Lambda)
+                        and self._lambda_is_callback(node, arg)
+                    ):
+                        add_anon_root("callback", mname, arg.body)
+        return list(roots.values())
+
+    def _lambda_is_callback(self, call: ast.Call, lam: ast.Lambda) -> bool:
+        """A lambda kwarg named on_*/callback is a registered callback; a
+        lambda in any other position (sort keys, tree_map fns) runs inline
+        under the enclosing method's root."""
+        for kw in call.keywords:
+            if kw.value is lam and kw.arg and (
+                kw.arg.startswith("on_") or "callback" in kw.arg
+            ):
+                return True
+        return False
+
+    def api_entries(self) -> List[ast.AST]:
+        return [
+            m
+            for name, m in self.methods.items()
+            if not name.startswith("_") or name in _PUBLIC_DUNDERS
+        ]
+
+    # ------------------------------------------------------------- traversal
+
+    def _entry_seeds(self, method: ast.AST) -> frozenset:
+        held: Set[str] = set()
+        m = GUARDED_BY_RE.search(self._line(method.lineno))
+        if m:
+            held.add(m.group(1).split(".", 1)[-1])
+        if getattr(method, "name", "").endswith("_locked"):
+            held.add("*")
+        return frozenset(held)
+
+    def traverse_root(self, root: _Root, api_mode: bool = False) -> None:
+        for body in root.bodies:
+            if isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._visit_method(root, body, self._entry_seeds(body), api_mode)
+            else:  # lambda body expression
+                self._visit_nodes(root, [body], frozenset(), api_mode, "<lambda>")
+
+    def _visit_method(
+        self, root: _Root, method: ast.AST, held: frozenset, api_mode: bool
+    ) -> None:
+        key = (root.label, id(method), held)
+        if key in self._visited:
+            return
+        self._visited.add(key)
+        if not api_mode:
+            self._real_owned.add(method)
+            if root.entry_name:
+                self._owned_by.setdefault(root.entry_name, set()).add(method.name)
+        self._visit_nodes(root, method.body, held, api_mode, method.name)
+
+    def _visit_nodes(
+        self,
+        root: _Root,
+        nodes: Sequence[ast.AST],
+        held: frozenset,
+        api_mode: bool,
+        where: str,
+    ) -> None:
+        for node in nodes:
+            self._visit_node(root, node, held, api_mode, where)
+
+    def _visit_node(
+        self, root: _Root, node: ast.AST, held: frozenset, api_mode: bool, where: str
+    ) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = set()
+            for item in node.items:
+                self._visit_node(root, item.context_expr, held, api_mode, where)
+                attr = _self_attr(item.context_expr)
+                if attr:
+                    acquired.add(attr)
+            inner = frozenset(held | acquired)
+            self._visit_nodes(root, node.body, inner, api_mode, where)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # nested defs run at call time; traversed only when referenced
+            # (thread targets become anonymous roots, local helpers are
+            # traversed inline at their call sites below)
+            return
+        if isinstance(node, ast.Call):
+            # method call on self: follow the edge under the current lockset
+            attr = _self_attr(node.func)
+            if attr and attr in self.methods:
+                callee = self.methods[attr]
+                if not (api_mode and callee in self._real_owned):
+                    entry = frozenset(held | self._entry_seeds(callee))
+                    self._visit_method(root, callee, entry, api_mode)
+            # local helper called (or passed) by name runs inline
+            # container mutator call == write to the attribute
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+                base = _self_attr(fn.value)
+                if base:
+                    self._record(base, True, root, held, fn.value.lineno, where)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._record_store_target(t, root, held, where)
+        elif isinstance(node, ast.AugAssign):
+            self._record_store_target(node.target, root, held, where)
+        elif isinstance(node, (ast.Attribute,)):
+            attr = _self_attr(node)
+            if attr is not None:
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                self._record(attr, write, root, held, node.lineno, where)
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(root, child, held, api_mode, where)
+
+    def _record_store_target(self, t: ast.AST, root, held, where) -> None:
+        # self.x = v and self.x[i] = v mutate the binding/container x;
+        # self.x.y = v mutates the OBJECT x points at — that store is the
+        # inner class's concern (recorded as a read of x here)
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        attr = _self_attr(base)
+        if attr is not None:
+            self._record(attr, True, root, held, base.lineno, where)
+
+    def _record(self, attr, write, root, held, line, where) -> None:
+        self.accesses.append(_Access(attr, write, root.label, held, line, where))
+
+    # --------------------------------------------------------------- verdict
+
+    def analyze(self) -> List[Finding]:
+        roots = self.discover_roots()
+        if not roots:
+            return []  # no concurrency in this class
+        for root in roots:
+            self.traverse_root(root, api_mode=False)
+        api = _Root(_API, _API, [])
+        for entry in self.api_entries():
+            self._visit_method(api, entry, self._entry_seeds(entry), api_mode=True)
+
+        by_attr: Dict[str, List[_Access]] = {}
+        for a in self.accesses:
+            if a.attr in self.exempt_attrs or a.attr in self.methods:
+                continue
+            by_attr.setdefault(a.attr, []).append(a)
+
+        root_names = {r.entry_name for r in roots if r.entry_name}
+        for attr in sorted(by_attr):
+            accesses = by_attr[attr]
+            if attr in self.guarded_attrs:
+                continue  # declared shared; lock-discipline enforces it
+            if attr in self.confined_attrs:
+                self._check_confined(attr, accesses, root_names)
+                continue
+            self._check_conflict(attr, accesses)
+        # context-sensitive traversal can record one site several times
+        seen: Set[Tuple[str, int, str]] = set()
+        unique: List[Finding] = []
+        for f in self.findings:
+            k = (f.path, f.line, f.message)
+            if k not in seen:
+                seen.add(k)
+                unique.append(f)
+        return unique
+
+    def _check_conflict(self, attr: str, accesses: List[_Access]) -> None:
+        root_labels = sorted({a.root for a in accesses})
+        writes = [a for a in accesses if a.write]
+        if len(root_labels) < 2 or not writes:
+            return
+        common: Optional[Set[str]] = None
+        for a in accesses:
+            if "*" in a.held:
+                continue
+            common = set(a.held) if common is None else (common & set(a.held))
+            if not common:
+                break
+        if common is None or common:
+            return  # every access lock-compatible
+        first = min(writes, key=lambda a: a.line)
+        self.findings.append(
+            Finding(
+                rule=self.rule,
+                severity=SEVERITY_ERROR,
+                path=self.module.rel,
+                line=first.line,
+                message=(
+                    f"self.{attr} in {self.cls.name} is mutated with no "
+                    f"common lock across concurrency roots "
+                    f"{', '.join(root_labels)} — guard it, or declare "
+                    "single-writer ownership with '# confined: <root>'"
+                ),
+            )
+        )
+
+    def _check_confined(
+        self, attr: str, accesses: List[_Access], root_names: Set[str]
+    ) -> None:
+        owner, decl_line = self.confined_attrs[attr]
+        if owner != _API and owner not in root_names:
+            self.findings.append(
+                Finding(
+                    rule=self.rule,
+                    severity=SEVERITY_ERROR,
+                    path=self.module.rel,
+                    line=decl_line,
+                    message=(
+                        f"self.{attr} in {self.cls.name} declares "
+                        f"'# confined: {owner}' but no concurrency root "
+                        f"named {owner} exists (known: "
+                        f"{', '.join(sorted(root_names | {_API}))})"
+                    ),
+                )
+            )
+            return
+        owner_methods = self._owned_by.get(owner, set())
+        for a in accesses:
+            if not a.write:
+                continue
+            root_entry = a.root.split(":", 1)[-1] if a.root != _API else _API
+            # a write in a method the owner root owns is the owner's code,
+            # whichever root reached it (drain()/tick() run the loop body
+            # inline in no-thread mode)
+            if root_entry != owner and a.method not in owner_methods:
+                self.findings.append(
+                    Finding(
+                        rule=self.rule,
+                        severity=SEVERITY_ERROR,
+                        path=self.module.rel,
+                        line=a.line,
+                        message=(
+                            f"self.{attr} in {self.cls.name} is declared "
+                            f"'# confined: {owner}' but is written from "
+                            f"root {a.root} (in {a.method})"
+                        ),
+                    )
+                )
+
+
+class ThreadSafetyPass(AnalysisPass):
+    rule = "thread-safety"
+    description = (
+        "attributes mutated from >= 2 inferred concurrency roots (threads, "
+        "executors, signal handlers, callbacks, public api) must share a "
+        "lock or declare '# confined: <root>' ownership"
+    )
+
+    def run(self, modules: Sequence[SourceModule], ctx: AnalysisContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(_ClassAudit(module, node, self.rule).analyze())
+        return findings

@@ -108,9 +108,15 @@ class ElasticCoordinator:
             float(startup_grace) if startup_grace is not None else
             max(30.0, 4.0 * self.timeout)
         )
-        self.generation = 0
+        # _beat_lock orders the beat writers: the beat thread, and
+        # start()/close() writing the first/last beat from the caller's
+        # thread.  close() joins with a TIMEOUT, so the final stopped-beat
+        # can genuinely overlap a still-live loop iteration — the lock is
+        # load-bearing there, not decoration.
+        self._beat_lock = threading.Lock()
+        self.generation = 0  # guarded by: self._beat_lock
         self._logger = logger
-        self._seq = 0
+        self._seq = 0  # guarded by: self._beat_lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._started_at: Optional[float] = None
@@ -121,19 +127,20 @@ class ElasticCoordinator:
         return os.path.join(self.directory, f"heartbeat_{rank}.json")
 
     def _write_beat(self, stopped: bool = False) -> None:
-        payload = {
-            "rank": self.process_index,
-            "pid": os.getpid(),
-            "generation": self.generation,
-            "seq": self._seq,
-            "time": time.time(),
-            "stopped": stopped,
-        }
-        self._seq += 1
-        tmp = self._path(self.process_index) + f".tmp{os.getpid()}"
-        with open(tmp, "w") as fp:
-            json.dump(payload, fp)
-        os.replace(tmp, self._path(self.process_index))  # atomic vs readers
+        with self._beat_lock:
+            payload = {
+                "rank": self.process_index,
+                "pid": os.getpid(),
+                "generation": self.generation,
+                "seq": self._seq,
+                "time": time.time(),
+                "stopped": stopped,
+            }
+            self._seq += 1
+            tmp = self._path(self.process_index) + f".tmp{os.getpid()}"
+            with open(tmp, "w") as fp:
+                json.dump(payload, fp)
+            os.replace(tmp, self._path(self.process_index))  # atomic vs readers
 
     def start(self) -> "ElasticCoordinator":
         """Write the first beat (bumping the generation past any previous
@@ -141,11 +148,13 @@ class ElasticCoordinator:
         os.makedirs(self.directory, exist_ok=True)
         prior = self._read(self._path(self.process_index))
         if prior is not None:
-            self.generation = int(prior.get("generation", -1)) + 1
+            with self._beat_lock:
+                self.generation = int(prior.get("generation", -1)) + 1
+                generation = self.generation
             if self._logger:
                 self._logger.info(
                     "elastic: rank %d rejoining as generation %d",
-                    self.process_index, self.generation,
+                    self.process_index, generation,
                 )
         self._started_at = time.monotonic()
         self._write_beat()

@@ -131,11 +131,14 @@ class Runner:
             initialize_distributed(
                 self.dist_url, self.num_nodes, self.rank, self.dist_backend
             )
-        self.current_rank = jax.process_index()
+        # confined: api — setup writes happen before any watchdog/callback
+        # thread exists; _on_hang's cross-thread reads are best-effort
+        # diagnostics on purpose
+        self.current_rank = jax.process_index()  # confined: api
         self.world_size = jax.device_count()  # chips, not processes
         self.distributed = self.world_size > 1
 
-        self.logger = logging.getLogger(f"worker_rank_{self.current_rank}")
+        self.logger = logging.getLogger(f"worker_rank_{self.current_rank}")  # confined: api
         self.logger.propagate = False
         if self.logger_queue is not None:
             self.logger.addHandler(QueueHandler(self.logger_queue))
@@ -281,7 +284,7 @@ class Runner:
             raise ValueError(
                 f"training.dct_denom must be 0 (auto), 1, 2, 4, or 8; got {dct_denom}"
             )
-        self.train_loader = train_loader = DataLoader(
+        self.train_loader = train_loader = DataLoader(  # confined: api
             train_dataset,
             batch_size=host_batch,
             sampler=train_sampler,
@@ -411,7 +414,7 @@ class Runner:
         # Built after the step path so its span recorder is live for the
         # whole loop; the compiled step families already registered with the
         # process-global jit-cache probe during path.build.
-        self._telemetry = Telemetry(
+        self._telemetry = Telemetry(  # confined: api
             enabled=self.telemetry_enabled,
             dir=self.telemetry_dir,
             host=self.current_rank,
@@ -449,7 +452,7 @@ class Runner:
         use_guard = self.checkpointer is not None and train_cfg["checkpoint"].get(
             "preemption", True
         )
-        self._preempt = None
+        self._preempt = None  # confined: api
         if use_guard:
             sigs = PreemptionGuard.parse_signals(
                 train_cfg["checkpoint"].get("preemption_signals", ("SIGTERM",))
@@ -480,7 +483,7 @@ class Runner:
                     f"{self._preempt_sync}"
                 )
         # --- hung-step watchdog (engine/watchdog.py; config-gated) ----------
-        self._watchdog = None
+        self._watchdog = None  # confined: api
         if self.watchdog_enabled:
             self._watchdog = StepWatchdog(
                 factor=self.watchdog_factor,

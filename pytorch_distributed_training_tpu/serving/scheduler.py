@@ -230,7 +230,7 @@ class ContinuousScheduler:
         # _fail_inflight / drain touch it cross-thread, and they take the
         # condition; per-iteration reads/writes in the loop body stay
         # lock-free by thread confinement (see module docstring).
-        self._slots: List[Optional[_PagedRequest]] = [None] * self.slots_n
+        self._slots: List[Optional[_PagedRequest]] = [None] * self.slots_n  # confined: _loop
         self._queue: "deque[_PagedRequest]" = deque()  # guarded by: self._cond
         self._cond = threading.Condition()
         self._closed = False  # guarded by: self._cond
@@ -252,9 +252,10 @@ class ContinuousScheduler:
         self._miss_blocks = 0
 
         # tick-thread-confined recovery state (supervisor runs inside
-        # tick's except clause, on the same thread)
-        self._tick_no = 0
-        self._tick_phase = ""
+        # tick's except clause, on the same thread); health/_on_tick_hang
+        # read them cross-thread as best-effort diagnostics
+        self._tick_no = 0  # confined: _loop
+        self._tick_phase = ""  # confined: _loop
 
         res = dict(resilience or {})
         wd = dict(res.pop("watchdog", None) or {})

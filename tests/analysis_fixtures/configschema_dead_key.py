@@ -1,0 +1,10 @@
+"""Seeded dead-key violation for the config-schema pass: the allow-set
+accepts ``retired_knob`` but no code ever reads it."""
+
+
+def parse_gadget(r, train_cfg: dict) -> None:
+    gadget = train_cfg.get("gadget") or {}
+    unknown = set(gadget) - {"enabled", "retired_knob"}
+    if unknown:
+        raise ValueError(f"unknown training.gadget keys: {sorted(unknown)}")
+    r.gadget_enabled = bool(gadget.get("enabled", False))

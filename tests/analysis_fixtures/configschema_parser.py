@@ -1,0 +1,13 @@
+"""Seeded config-schema surface (parsed, never imported): one closed
+``training.widget`` section in the topology idiom, with typed keys the
+YAML fixtures exercise."""
+
+
+def parse_widget(r, train_cfg: dict) -> None:
+    widget = train_cfg.get("widget") or {}
+    unknown = set(widget) - {"enabled", "threshold", "mode"}
+    if unknown:
+        raise ValueError(f"unknown training.widget keys: {sorted(unknown)}")
+    r.widget_enabled = bool(widget.get("enabled", False))
+    r.widget_threshold = float(widget.get("threshold", 0.5))
+    r.widget_mode = widget.get("mode", "auto")

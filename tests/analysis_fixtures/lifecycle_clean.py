@@ -1,0 +1,51 @@
+"""Clean twins for the resource-lifecycle pass: release on every path
+via finally/except, ownership escapes, daemon exemption, and the
+with-statement form."""
+import threading
+from concurrent.futures import Future
+
+
+def resolve_on_every_path(model, batch):
+    fut = Future()
+    try:
+        fut.set_result(model.run(batch))
+    except Exception as exc:
+        fut.set_exception(exc)
+    return fut.done()
+
+
+def future_escapes_to_caller(model, batch):
+    fut = Future()
+    model.submit(batch, fut)  # ownership transferred to the model queue
+    return True
+
+
+def joined_worker(work):
+    t = threading.Thread(target=work)
+    t.start()
+    try:
+        work.prepare()
+    finally:
+        t.join()
+    return True
+
+
+def daemon_sidecar(work):
+    # daemon threads may be deliberately abandoned (elastic.guard's
+    # timeout path) — exempt from the join requirement
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    return True
+
+
+def blocks_returned_to_pool(pool, n):
+    got = pool.alloc(n)
+    if got is None:
+        return None
+    pool.free(got)  # handed back: ownership returns to the pool
+    return n
+
+
+def with_managed_file(path):
+    with open(path) as fh:
+        return fh.read()

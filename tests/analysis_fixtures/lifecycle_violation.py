@@ -1,0 +1,34 @@
+"""Seeded resource-lifecycle violations (parsed, never imported): the
+in-flight-future bug class, a dropped future, a never-joined non-daemon
+thread, and a file handle lost on an exception edge."""
+import threading
+from concurrent.futures import Future
+
+
+def leak_on_exception_edge(model, batch):
+    fut = Future()
+    out = model.run(batch)  # may raise -> fut never resolves
+    fut.set_result(out)
+    return True
+
+
+def definite_future_leak(n):
+    fut = Future()
+    return n + 1  # fut neither resolved nor handed to anyone
+
+
+def unjoined_worker(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return True  # never joined, not daemon: blocks interpreter exit
+
+
+def file_leak_on_exception(path, payload):
+    fh = open(path, "w")
+    fh.write(_serialize(payload))  # may raise -> fh never closed
+    fh.close()
+    return True
+
+
+def _serialize(payload):
+    return str(payload)

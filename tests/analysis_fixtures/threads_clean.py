@@ -1,0 +1,74 @@
+"""Clean twins for the thread-safety pass: the same concurrency shapes
+as threads_violation.py, made safe three different ways — a shared lock
+the inferencer sees on every access, a declared-and-honored confinement,
+and pure message passing through exempt synchronized containers."""
+import queue
+import threading
+
+
+class LockedWatchdog:
+    """RacyWatchdog with the lock actually taken on both sides."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self._lock = threading.Lock()
+        self.fires = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.05):
+            with self._lock:
+                self.fires += 1
+
+    def fired(self):
+        with self._lock:
+            return self.fires > 0
+
+    def stop(self):
+        self._stop.set()
+
+
+class ConfinedScheduler:
+    """Single-writer confinement declared and honored: only the loop
+    thread writes the slot list; api reads take the stale-read bargain."""
+
+    def __init__(self, n):
+        self._slots = [None] * n  # confined: _loop
+        self._inbox = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            job = self._inbox.get()
+            if job is None:
+                return
+            self._slots[0] = job
+
+    def submit(self, job):
+        self._inbox.put(job)
+
+    def active(self):
+        return sum(1 for s in self._slots if s is not None)
+
+
+class MessagePassing:
+    """No shared mutable state: queues are internally synchronized (and
+    exempt), config attributes are written once in __init__."""
+
+    def __init__(self, interval):
+        self.interval = interval
+        self._q = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+
+    def push(self, item):
+        self._q.put(item)

@@ -1,0 +1,79 @@
+"""Seeded thread-safety violations (parsed by the analyzer, never
+imported).  The first two classes replay the two REAL races PR 8's
+annotation-based pass caught — but stripped of every lock-declaration
+comment, so only lockset inference can flag them."""
+import threading
+
+
+class RacyWatchdog:
+    """PR 8 race shape #1: the monitor thread bumps a counter the api
+    polls, no lock anywhere."""
+
+    def __init__(self, limit):
+        self.limit = limit
+        self.fires = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.05):
+            if self._elapsed() > self.limit:
+                self.fires += 1  # racy read-modify-write from the monitor
+
+    def _elapsed(self):
+        return 0.0
+
+    def fired(self):
+        return self.fires > 0
+
+    def stop(self):
+        self._stop.set()
+
+
+class RacyScheduler:
+    """PR 8 race shape #2: api snapshots the slot list lock-free while
+    the loop thread mutates and wholesale-rebinds it.  The queue, by
+    contrast, rides the lock on both sides — inference must see that
+    intersection and stay quiet about it."""
+
+    def __init__(self, n):
+        self._slots = [None] * n
+        self._lock = threading.Lock()
+        self._queue = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                job = self._queue.pop() if self._queue else None
+            if job is None:
+                break
+            self._slots[job % len(self._slots)] = job
+            self._slots = [s for s in self._slots if s is not None] + [None]
+
+    def submit(self, job):
+        with self._lock:
+            self._queue.append(job)
+
+    def active(self):
+        return sum(1 for s in self._slots if s is not None)  # lock-free snapshot
+
+
+class BadConfinement:
+    """Confinement declarations the verifier must reject: one names a
+    root that does not exist, the other is violated by an api write."""
+
+    def __init__(self):
+        self._ticks = 0  # confined: _loop
+        self._phase = ""  # confined: _nonexistent
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._ticks += 1
+        self._phase = "tick"
+
+    def reset(self):
+        self._ticks = 0  # api write into loop-confined state

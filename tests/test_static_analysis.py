@@ -13,7 +13,10 @@ Layout:
   - the collective-order per-family extraction oracle (recorded in
     PERF.md as the baseline for the step-family unification work);
   - regression pins for the real findings this analyzer surfaced and
-    fixed (watchdog fire counter, scheduler active()).
+    fixed (watchdog fire counter, scheduler active(), elastic beat lock);
+  - the v2 inference passes: thread-safety re-detecting both PR 8 races
+    from fixtures WITHOUT annotations, resource-lifecycle exception-edge
+    leaks, and the generated config schema validating the shipped YAMLs.
 """
 import ast
 import json
@@ -30,10 +33,17 @@ from pytorch_distributed_training_tpu.analysis.collectives import (
     CollectiveOrderPass,
     extract_collective_sequences,
 )
+from pytorch_distributed_training_tpu.analysis.configschema import (
+    ConfigSchemaPass,
+    extract_schema,
+    schema_as_json,
+)
 from pytorch_distributed_training_tpu.analysis.conventions import MarkerConventionPass
 from pytorch_distributed_training_tpu.analysis.donation import DonationSafetyPass
+from pytorch_distributed_training_tpu.analysis.lifecycle import ResourceLifecyclePass
 from pytorch_distributed_training_tpu.analysis.locks import LockDisciplinePass
 from pytorch_distributed_training_tpu.analysis.purity import TracePurityPass
+from pytorch_distributed_training_tpu.analysis.threads import ThreadSafetyPass
 
 REPO = pathlib.Path(__file__).parent.parent
 PKG = REPO / "pytorch_distributed_training_tpu"
@@ -431,7 +441,7 @@ def test_scheduler_active_snapshots_under_condition():
     assert "self._cond" in guarded_src and "_slots" in guarded_src
 
 
-def test_framework_registers_all_five_passes():
+def test_framework_registers_all_eight_passes():
     rules = {cls.rule for cls in analysis.ALL_PASSES}
     assert rules == {
         "trace-purity",
@@ -439,7 +449,32 @@ def test_framework_registers_all_five_passes():
         "collective-order",
         "donation-safety",
         "marker-convention",
+        "thread-safety",
+        "resource-lifecycle",
+        "config-schema",
     }
+
+
+def test_unregistered_pass_fails_the_registration_pin(tmp_path):
+    """A new AnalysisPass subclass that never lands in ALL_PASSES is
+    itself a marker-convention finding — the framework refuses to let a
+    pass exist that runs nowhere."""
+    pkg = tmp_path / "pkg"
+    ana = pkg / "analysis"
+    ana.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (ana / "__init__.py").write_text("ALL_PASSES = ()\n")
+    (ana / "rogue.py").write_text(
+        "from ..core import AnalysisPass\n\n\n"
+        "class RoguePass(AnalysisPass):\n"
+        "    rule = 'rogue'\n"
+    )
+    ctx = core.AnalysisContext(package_root=pkg, repo_root=tmp_path)
+    modules = core.collect_modules(pkg, tmp_path)
+    findings = MarkerConventionPass().run(modules, ctx)
+    assert any(
+        "RoguePass" in f.message and "ALL_PASSES" in f.message for f in findings
+    )
 
 
 # --------------------------------------------- serving fault-tolerance gate
@@ -483,3 +518,219 @@ def test_serving_recovery_state_is_lock_annotated():
     # submit/deliver/failover path against it
     router = (PKG / "serving" / "router.py").read_text()
     assert router.count("# guarded by: self._lock") >= 6
+
+
+# ------------------------------------ v2: inferred-lockset thread safety
+
+
+def test_thread_pass_redetects_both_pr8_races_without_annotations():
+    """THE v2 acceptance bar: the fixtures replay the watchdog fire-count
+    bump and the scheduler slot snapshot — the two real races PR 8's
+    annotation-based pass caught — with every ``# guarded by:`` comment
+    stripped.  Inference alone must flag both."""
+    src = (FIXTURES / "threads_violation.py").read_text()
+    assert "guarded by" not in src  # nothing for the annotation pass to key off
+    findings = _fixture_findings(ThreadSafetyPass, "threads_violation.py")
+    messages = "\n".join(f.message for f in findings)
+    assert "self.fires in RacyWatchdog" in messages  # PR 8 race shape #1
+    assert "thread:_run" in messages
+    assert "self._slots in RacyScheduler" in messages  # PR 8 race shape #2
+    assert "thread:_loop" in messages
+    # the lock-ridden queue in RacyScheduler must NOT be flagged: both
+    # sides take self._lock, and the inferred locksets intersect
+    assert "_queue" not in messages
+
+
+def test_thread_pass_verifies_confinement_declarations():
+    findings = _fixture_findings(ThreadSafetyPass, "threads_violation.py")
+    messages = "\n".join(f.message for f in findings)
+    # naming a root that does not exist is itself a finding...
+    assert "_nonexistent" in messages
+    # ...and so is an api-side write into loop-confined state
+    assert "written from root api (in reset)" in messages
+    assert len(findings) == 4  # the two races + the two confinement breaks
+
+
+def test_thread_pass_clean_fixture_stays_clean():
+    """Locked, confined-and-honored, and message-passing twins of the
+    racy shapes produce zero findings."""
+    assert _fixture_findings(ThreadSafetyPass, "threads_clean.py") == []
+
+
+def test_thread_suppression_round_trip(tmp_path):
+    """``# pdt: ignore[thread-safety]`` on the write line suppresses the
+    race finding and is accounted as suppressed, not dropped."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "racy.py").write_text(
+        "import threading\n\n\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n"
+        "        self._t.start()\n\n"
+        "    def _run(self):\n"
+        "        self.n += 1  # pdt: ignore[thread-safety]\n\n"
+        "    def read(self):\n"
+        "        return self.n\n"
+    )
+    result = analysis.run(package_root=pkg, rules=["thread-safety"])
+    assert result.unsuppressed == []
+    assert len(result.suppressed) == 1
+
+
+# ----------------------------------------------- v2: resource lifecycle
+
+
+def test_lifecycle_pass_flags_seeded_leaks():
+    findings = _fixture_findings(ResourceLifecyclePass, "lifecycle_violation.py")
+    messages = "\n".join(f.message for f in findings)
+    # the in-flight-future bug class: a call between acquire and resolve
+    # can raise, leaving the caller blocked on a future nobody resolves
+    assert "leak_on_exception_edge" in messages and "exception edge" in messages
+    assert "definite_future_leak" in messages and "never reaches" in messages
+    assert "unjoined_worker" in messages and "join" in messages
+    assert "file_leak_on_exception" in messages
+    assert len(findings) == 4
+
+
+def test_lifecycle_clean_fixture_stays_clean():
+    """finally/except release, ownership escapes, daemon exemption and
+    with-managed handles are all recognized as safe."""
+    assert _fixture_findings(ResourceLifecyclePass, "lifecycle_clean.py") == []
+
+
+def test_new_rules_baseline_round_trip(tmp_path):
+    """A baseline written against the v2 findings silences exactly those
+    findings on re-run — adoption path for a tree not yet clean."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for name in ("threads_violation.py", "lifecycle_violation.py"):
+        shutil.copy(FIXTURES / name, pkg / name)
+    rules = ["thread-safety", "resource-lifecycle"]
+    first = analysis.run(package_root=pkg, rules=rules)
+    assert len(first.unsuppressed) == 8
+    baseline = tmp_path / "baseline.json"
+    core.write_baseline(baseline, first.unsuppressed)
+    second = analysis.run(package_root=pkg, rules=rules, baseline=baseline)
+    assert second.unsuppressed == []
+    assert len(second.baselined) == 8
+
+
+# --------------------------------------------------- v2: config schema
+
+
+def _configschema_findings(fixture, config_dirname):
+    ctx = core.AnalysisContext(
+        package_root=FIXTURES,
+        repo_root=FIXTURES.parent,
+        config_dir=FIXTURES / config_dirname,
+    )
+    modules = [
+        m
+        for m in core.collect_modules(FIXTURES, FIXTURES.parent)
+        if pathlib.Path(m.rel).name == fixture
+    ]
+    assert modules, f"missing fixture {fixture}"
+    return ConfigSchemaPass().run(modules, ctx)
+
+
+def test_configschema_flags_unknown_key_and_type_mismatch():
+    findings = _configschema_findings("configschema_parser.py", "configs_violation")
+    messages = "\n".join(f.message for f in findings)
+    assert "unknown key training.widget.treshold" in messages  # the typo
+    assert "type mismatch for training.widget.mode" in messages
+    assert len(findings) == 2
+    # both findings point into the YAML file, at the offending lines
+    assert all(f.path.endswith("bad.yml") for f in findings)
+
+
+def test_configschema_clean_yaml_validates():
+    assert _configschema_findings("configschema_parser.py", "configs_clean") == []
+
+
+def test_configschema_flags_dead_allowset_key():
+    findings = _configschema_findings("configschema_dead_key.py", "no_such_configs")
+    assert len(findings) == 1
+    assert "retired_knob" in findings[0].message
+    assert "dead key" in findings[0].message
+    assert findings[0].path.endswith("configschema_dead_key.py")
+
+
+def test_configschema_extraction_shape():
+    """The generated schema records section closure, key types and
+    defaults — the machine-readable config reference ``--schema`` dumps."""
+    modules = [
+        m
+        for m in core.collect_modules(FIXTURES, FIXTURES.parent)
+        if pathlib.Path(m.rel).name == "configschema_parser.py"
+    ]
+    dump = schema_as_json(extract_schema(modules))
+    widget = dump["training.widget"]
+    assert widget["closed"] is True
+    assert set(widget["keys"]) == {"enabled", "threshold", "mode"}
+    assert widget["keys"]["threshold"]["type"] == "float"
+    assert widget["keys"]["mode"]["type"] == "str"
+
+
+def test_shipped_configs_validate_against_generated_schema():
+    """All shipped config/*.yml files validate against the schema
+    inferred from the topology/from_config parsing surface — the
+    config-schema slice of the tier-1 gate, pinned explicitly."""
+    ctx = core.AnalysisContext(package_root=PKG, repo_root=REPO)
+    modules = core.collect_modules(PKG, REPO)
+    findings = ConfigSchemaPass().run(modules, ctx)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    assert len(list((REPO / "config").glob("*.yml"))) == 13
+    # and the real schema covers the sections the YAMLs actually use
+    dump = schema_as_json(extract_schema(modules))
+    for section in ("training", "serving.scheduler", "training.checkpoint"):
+        assert section in dump, f"schema lost the {section} section"
+
+
+def test_cli_schema_flag_dumps_json():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytorch_distributed_training_tpu.analysis",
+            "--schema",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    dump = json.loads(proc.stdout)
+    assert "training" in dump and "serving.fleet" in dump
+    assert dump["serving.fleet"]["closed"] is True  # the dict-pop idiom
+
+
+# ----------------------------- regression pin: elastic heartbeat beat lock
+
+
+def test_elastic_generation_and_seq_update_under_beat_lock():
+    """pdt-analyze v2 finding (fixed this PR): ElasticCoordinator.start()
+    bumped ``self.generation`` while the beat thread read it — and
+    close() joins with a TIMEOUT, so the final stopped-beat write can
+    genuinely overlap a still-live loop iteration.  Pin that the beat
+    payload writes sit inside ``with self._beat_lock`` and that both
+    inference and annotation passes stay clean on the module."""
+    src = (PKG / "engine" / "elastic.py").read_text()
+    tree = ast.parse(src)
+    assert src.count("# guarded by: self._beat_lock") >= 2  # generation, _seq
+    write_beat = _method(tree, "ElasticCoordinator", "_write_beat")
+    withs = [n for n in ast.walk(write_beat) if isinstance(n, ast.With)]
+    assert withs and "self._beat_lock" in ast.unparse(withs[0])
+    assert "_seq" in ast.unparse(withs[0])  # the payload build rides the lock
+    ctx = core.AnalysisContext(package_root=PKG, repo_root=REPO)
+    modules = [
+        m
+        for m in core.collect_modules(PKG, REPO)
+        if m.rel.endswith("engine/elastic.py")
+    ]
+    assert ThreadSafetyPass().run(modules, ctx) == []
+    assert LockDisciplinePass().run(modules, ctx) == []
