@@ -2192,6 +2192,88 @@ def bench_overlap():
     )
 
 
+def bench_soak():
+    """Chaos soak: N seeded multi-fault scenarios through the real stacks.
+
+    The scenario schedule is a pure function of BENCH_SOAK_SEED — the same
+    seed replays byte-identical specs, so a red soak is rerunnable.  Each
+    scenario composes 2-4 faults from the registered menu (engine/chaos.py
+    FAULT_MENU), runs them through the Runner / serving scheduler / fleet,
+    and is judged by the shared oracles: bit-parity vs an uninjected twin
+    where the ladders guarantee it, exact fired-fault accounting, recovery
+    SLOs from trace spans, goodput floor, kv-pool and thread hygiene.
+
+    Env knobs:
+      BENCH_SOAK_SEED       scenario-schedule seed (default 42)
+      BENCH_SOAK_SCENARIOS  scenario count (default 20)
+      BENCH_SOAK_FAMILIES   comma list from train,serve,elastic,fleet
+                            (default: all four)
+      BENCH_SOAK_GOODPUT_FLOOR  min goodput ratio per train scenario
+                            (default 0.05)
+
+    Exit status mirrors bench_lint: 0 all green, 1 any scenario red
+    (skipped scenarios — e.g. elastic on a CPU backend without
+    multi-process support — are reported but not failures).
+    """
+    from pytorch_distributed_training_tpu.engine.chaos import ChaosSoakEngine
+
+    seed = int(os.environ.get("BENCH_SOAK_SEED", "42"))
+    n = int(os.environ.get("BENCH_SOAK_SCENARIOS", "20"))
+    fams = tuple(
+        f.strip()
+        for f in os.environ.get(
+            "BENCH_SOAK_FAMILIES", "train,serve,elastic,fleet"
+        ).split(",")
+        if f.strip()
+    )
+    floor = float(os.environ.get("BENCH_SOAK_GOODPUT_FLOOR", "0.05"))
+    eng = ChaosSoakEngine(seed=seed, families=fams, goodput_floor=floor)
+    t0 = time.monotonic()
+    summary = eng.run(n)
+    compact = [
+        {
+            k: r[k]
+            for k in (
+                "index", "family", "overlap", "spec", "ok", "failures",
+                "skipped", "parity", "goodput_ratio", "duration_s",
+            )
+            if k in r
+        }
+        for r in summary["results"]
+    ]
+    print(
+        json.dumps(
+            {
+                "metric": f"chaos soak: {n} seeded multi-fault scenarios "
+                "(oracle-judged), scenarios passed",
+                "value": summary["passed"],
+                "unit": "scenarios",
+                "seed": summary["seed"],
+                "families": summary["families"],
+                "failed": summary["failed"],
+                "skipped": summary["skipped"],
+                "mttr_ms_max": summary["mttr_ms_max"],
+                "mttr_ms_mean": summary["mttr_ms_mean"],
+                "goodput_floor": summary["goodput_floor"],
+                "kinds_exercised": summary["kinds_exercised"],
+                "kinds_uncovered": summary["kinds_uncovered"],
+                "coverage": summary["coverage"],
+                "results": compact,
+                "wall_s": round(time.monotonic() - t0, 1),
+            }
+        )
+    )
+    if summary["failed"]:
+        for r in summary["results"]:
+            if not r["ok"]:
+                print(
+                    f"SOAK RED scenario {r['index']} [{r['family']}] "
+                    f"{r['spec']}: {r['failures']}",
+                    file=sys.stderr,
+                )
+        sys.exit(1)
+
+
 def bench_lint():
     """Run pdt-analyze over the package tree; one-line JSON verdict.
 
@@ -2247,7 +2329,7 @@ if __name__ == "__main__":
     if mode not in (
         "chaos", "--chaos", "chaos-serve", "--chaos-serve",
         "chaos-integrity", "--chaos-integrity",
-        "chaos-fleet", "--chaos-fleet", "lint"
+        "chaos-fleet", "--chaos-fleet", "soak", "--soak", "lint"
     ) or os.environ.get("BENCH_COMPILE_CACHE"):
         _enable_compile_cache()
     if mode == "lint":
@@ -2278,6 +2360,8 @@ if __name__ == "__main__":
         bench_chaos_integrity()
     elif mode in ("chaos-fleet", "--chaos-fleet"):
         bench_chaos_fleet()
+    elif mode in ("soak", "--soak"):
+        bench_soak()
     elif mode in ("fleet-serve", "--fleet-serve"):
         bench_fleet_serve()
     elif mode == "accuracy":

@@ -56,6 +56,7 @@ BENCH_DRIVERS = (
     "bench_overlap(",
     "bench_chaos_fleet(",
     "bench_fleet_serve(",
+    "bench_soak(",
 )
 
 FAULT_MACHINERY = (
@@ -70,6 +71,8 @@ FAULT_MACHINERY = (
     "sdc_flip",
     "multihost_worker",
     "MH_ELASTIC",
+    "ChaosSoakEngine",
+    "ScenarioGenerator",
 )
 HEAVY_INDICATORS = ("time.sleep(", "os.kill(", "Process(", "subprocess")
 

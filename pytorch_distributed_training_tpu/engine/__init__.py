@@ -3,6 +3,13 @@
 Replaces the reference's L4/L6 layers (``Runner`` process orchestration and
 the hot loops, train_distributed.py:89-331) — see runner.py / steps.py.
 """
+from .chaos import (
+    FAULT_MENU,
+    ChaosSoakEngine,
+    Scenario,
+    ScenarioGenerator,
+    coverage_matrix,
+)
 from .elastic import ElasticCoordinator, PeerLostError
 from .integrity import DivergedReplicaError, IntegritySentinel
 from .profiling import TraceProfiler
@@ -18,11 +25,16 @@ from .steps import (
 from .tp_steps import build_tp_lm_train_step
 
 __all__ = [
+    "FAULT_MENU",
+    "ChaosSoakEngine",
     "DivergedReplicaError",
     "ElasticCoordinator",
     "IntegritySentinel",
     "PeerLostError",
     "Runner",
+    "Scenario",
+    "ScenarioGenerator",
+    "coverage_matrix",
     "TraceProfiler",
     "TrainState",
     "build_train_step",

@@ -221,7 +221,7 @@ class Checkpointer:
 
     def __init__(self, directory: str, interval: int = 1000, max_to_keep: int = 3,
                  retry: Optional["Retry"] = None, async_save: bool = False,
-                 max_inflight: int = 1):
+                 max_inflight: int = 1, emergency_drain_timeout_s: float = 5.0):
         import orbax.checkpoint as ocp
 
         from ..utils.retry import Retry
@@ -230,11 +230,17 @@ class Checkpointer:
             raise ValueError(
                 f"checkpoint.max_inflight must be >= 1, got {max_inflight}"
             )
+        if float(emergency_drain_timeout_s) <= 0:
+            raise ValueError(
+                "checkpoint.emergency_drain_timeout_s must be > 0, got "
+                f"{emergency_drain_timeout_s}"
+            )
         self.directory = os.path.abspath(os.path.expanduser(directory))
         self.interval = int(interval)
         self.max_to_keep = int(max_to_keep)
         self.async_save = bool(async_save)
         self.max_inflight = int(max_inflight)
+        self.emergency_drain_timeout_s = float(emergency_drain_timeout_s)
         self.retry = retry if retry is not None else Retry(
             logger=logging.getLogger(__name__)
         )
@@ -273,25 +279,31 @@ class Checkpointer:
         from ..utils.retry import Retry
 
         rc = ck.get("retry") or {}
-        unknown = set(rc) - {"attempts", "backoff", "max_backoff", "jitter"}
+        unknown = set(rc) - {
+            "attempts", "backoff", "max_backoff", "jitter", "total_timeout_s",
+        }
         if unknown:
             raise ValueError(
                 f"checkpoint.retry: unknown key(s) {sorted(unknown)} "
-                "(want attempts/backoff/max_backoff/jitter)"
+                "(want attempts/backoff/max_backoff/jitter/total_timeout_s)"
             )
+        tts = rc.get("total_timeout_s")
         retry = Retry(
             attempts=int(rc.get("attempts", 3)),
             backoff=float(rc.get("backoff", 0.25)),
             max_backoff=float(rc.get("max_backoff", 8.0)),
             jitter=float(rc.get("jitter", 0.25)),
+            total_timeout_s=float(tts) if tts is not None else None,
             logger=logging.getLogger(__name__),
         )
+        edt = ck.get("emergency_drain_timeout_s", 5.0)
         return cls(ck["dir"], interval=ck.get("interval", 1000),
                    max_to_keep=ck.get("max_to_keep", 3), retry=retry,
                    # "async" is a Python keyword, hence the differing
                    # constructor parameter name
                    async_save=bool(ck.get("async", False)),
-                   max_inflight=int(ck.get("max_inflight", 1)))
+                   max_inflight=int(ck.get("max_inflight", 1)),
+                   emergency_drain_timeout_s=float(edt))
 
     def latest(self) -> Optional[int]:
         return self._manager.latest_step()
@@ -620,12 +632,21 @@ class Checkpointer:
         from . import fault
 
         # Drain the async writer first so two writers never race on the
-        # checkpoint dir.  Bounded wait, errors dropped: with a dead peer a
+        # checkpoint dir.  Bounded wait (``emergency_drain_timeout_s`` —
+        # must fit inside the preemption grace window, NOT the generic
+        # 30s-class drain bound), errors dropped: with a dead peer a
         # background write can be wedged in a stuck filesystem op, and the
         # emergency dump must still happen — it goes to its own subdir, and
         # an abandoned half-written orbax step stays uncommitted (tmp-dir
         # name), invisible to restore.
-        self.drain(raise_errors=False, timeout=30.0)
+        if not self.drain(raise_errors=False,
+                          timeout=self.emergency_drain_timeout_s):
+            fault.bump("emergency_drain_timeouts")
+            logging.getLogger(__name__).warning(
+                "emergency save at step %d: async writer still busy after "
+                "%.1fs drain bound — abandoning the in-flight write and "
+                "dumping now", it, self.emergency_drain_timeout_s,
+            )
         flat, _ = jax.tree_util.tree_flatten_with_path(state)
         arrays = {}
         specs = {}

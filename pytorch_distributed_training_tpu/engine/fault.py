@@ -8,7 +8,13 @@ parsed from the ``PDT_FAULT_SPEC`` environment variable (or the
 ``training.fault_tolerance.fault_spec`` config key; env wins so a chaos
 wrapper can override any config).
 
-Spec grammar — semicolon-separated entries, each ``kind@step[:arg]``:
+Spec grammar — a list of entries separated by ``;`` or ``,`` (both
+accepted so shell-quoted comma lists like
+``PDT_FAULT_SPEC="kill_peer@8,sdc_flip@9:0"`` compose multiple concurrent
+faults), each entry ``kind@step[:arg]``.  The whole list is validated at
+parse time: any malformed entry, unknown kind, or duplicate ``kind@step``
+pair rejects the entire spec — a chaos scenario must fail loudly at
+install, never silently drop one of its faults:
 
     nan_batch@K        poison the training batch fed to step K with NaNs
                        (float image pipelines; the anomaly guard must skip
@@ -99,6 +105,7 @@ the same numbers.
 from __future__ import annotations
 
 import os
+import re
 import threading
 from collections import Counter
 from typing import Dict, List, Optional, Tuple
@@ -158,8 +165,12 @@ class FaultInjector:
         # fail point -> [(first_attempt, n_failures)]
         self._fail_windows: Dict[str, List[Tuple[int, int]]] = {}
         self._attempts: Counter = Counter()
+        # kind -> number of injected faults that actually FIRED (one-shot
+        # takes and fail-point window hits); the soak oracle balances this
+        # against pending() to prove no armed fault silently leaked
+        self._fired: Counter = Counter()
         self._lock = threading.Lock()
-        for raw in self.spec.split(";"):
+        for raw in re.split(r"[;,]", self.spec):
             entry = raw.strip()
             if not entry:
                 continue
@@ -204,6 +215,11 @@ class FaultInjector:
                         f"bad {ENV_VAR} entry {entry!r}: {kind} takes no arg"
                     )
                 val = 1.0
+            if step in self._step_faults[kind]:
+                raise ValueError(
+                    f"bad {ENV_VAR} entry {entry!r}: duplicate {kind}@{step} "
+                    f"(each kind@step pair may appear once per spec)"
+                )
             self._step_faults[kind][step] = val
         else:
             raise ValueError(
@@ -223,7 +239,12 @@ class FaultInjector:
         ``stall_step``/``serve_hang``, 1.0 for the no-arg kinds).
         """
         with self._lock:
-            return self._step_faults[kind].pop(int(step), None)
+            val = self._step_faults[kind].pop(int(step), None)
+            if val is not None:
+                self._fired[kind] += 1
+        if val is not None:
+            bump(f"fault_fired_{kind}")
+        return val
 
     def check_fail_point(self, point: str) -> None:
         """Raise :class:`FaultInjectionError` when this attempt ordinal of
@@ -234,11 +255,45 @@ class FaultInjector:
             windows = self._fail_windows.get(point, ())
         for first, n in windows:
             if first <= ordinal < first + n:
+                with self._lock:
+                    self._fired[point] += 1
                 bump(f"injected_{point}_failures")
                 raise FaultInjectionError(
                     f"injected {point} failure (attempt ordinal {ordinal}, "
                     f"window {first}+{n})"
                 )
+
+    def pending(self) -> Dict[str, List[int]]:
+        """Armed faults that have NOT fired yet, ``kind -> sorted steps``.
+
+        One-shot entries are listed by step index; fail-point windows by the
+        attempt ordinals the process never reached.  A fault armed for a
+        step/tick/attempt that never happens (engine drained or closed
+        first) would otherwise vanish without a trace — the chaos soak
+        oracle balances this against :meth:`fired` so every injected fault
+        is accounted for as exactly one of fired-and-recovered or
+        reported-unfired.
+        """
+        with self._lock:
+            out: Dict[str, List[int]] = {
+                kind: sorted(steps)
+                for kind, steps in self._step_faults.items()
+                if steps
+            }
+            for point, windows in self._fail_windows.items():
+                seen = self._attempts[point]
+                left = sorted(
+                    o for first, n in windows
+                    for o in range(first, first + n) if o >= seen
+                )
+                if left:
+                    out[point] = left
+        return out
+
+    def fired(self) -> Dict[str, int]:
+        """Counts of injected faults that actually fired, by kind/point."""
+        with self._lock:
+            return dict(self._fired)
 
 
 # ---------------------------------------------------------------- process-global

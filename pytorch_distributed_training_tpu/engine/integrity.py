@@ -302,6 +302,17 @@ class IntegritySentinel:
         with self._lock:
             self._snapshot = snap
 
+    def rebase(self, state, step: int, position: Optional[dict] = None) -> None:
+        """Re-anchor the sentinel on a state restored from OUTSIDE it
+        (anomaly rollback, checkpoint resume): retain the restored state as
+        the new recovery point AND clear the per-replica consecutive
+        divergence streaks — they were measured against a timeline the
+        caller just abandoned, so carrying them forward would escalate the
+        first post-restore divergence straight to quarantine."""
+        self.retain(state, step, position)
+        with self._lock:
+            self._consec.clear()
+
     @property
     def snapshot_step(self) -> Optional[int]:
         with self._lock:

@@ -894,6 +894,21 @@ class Runner:
         self._init_pipeline_position()
         self._consec_anomalies = 0
         self._gnorm_hist.clear()
+        # The restored steps replay against a cold pipeline (recompiles,
+        # page cache misses) — a trailing median learned before the fault
+        # would read the first replayed step as a hang/anomaly.  Re-enter
+        # the watchdog's warmup instead of trusting the stale window.
+        if self._watchdog:
+            self._watchdog.reset()
+        # Re-base the integrity sentinel on the restored state: its retained
+        # snapshot still belongs to the ABANDONED pre-rollback timeline, so
+        # an SDC detected during the replay would "recover" to state the
+        # rollback just discarded (or all the way to the startup snapshot),
+        # silently resurrecting the dropped anomalous steps.
+        if self._integrity is not None:
+            self._integrity.rebase(
+                self.state, start_iter - 1, self._pipeline_extras()
+            )
         return self._make_stream()
 
     def _integrity_recover(self, iter_generator, verdict):
@@ -937,6 +952,13 @@ class Runner:
             self._epoch, self._batch_in_epoch = divmod(
                 self.iter, self._batches_per_epoch
             )
+        # Same staleness hazard as _rollback: the replay runs cold, so the
+        # hang watchdog and the anomaly guard's grad-norm median must both
+        # re-warm instead of judging replayed steps by pre-fault timings.
+        self._consec_anomalies = 0
+        self._gnorm_hist.clear()
+        if self._watchdog:
+            self._watchdog.reset()
         return self._make_stream()
 
     def _on_diverged(self, e: DivergedReplicaError):
@@ -1033,7 +1055,8 @@ class Runner:
                 and self._consec_anomalies >= self.anomaly_max_consec
             ):
                 rb_t0 = time.monotonic()
-                iter_generator = self._rollback(iter_generator, train_cfg)
+                with tel.span("rollback", step=self.iter):
+                    iter_generator = self._rollback(iter_generator, train_cfg)
                 tel.note_lost("rollback", time.monotonic() - rb_t0)
                 continue
             if self._integrity is not None and self._integrity.due(self.iter):
@@ -1056,9 +1079,10 @@ class Runner:
                     )
                 if verdict["local_diverged"]:
                     rc_t0 = time.monotonic()
-                    iter_generator = self._integrity_recover(
-                        iter_generator, verdict
-                    )
+                    with tel.span("integrity_restore", step=self.iter):
+                        iter_generator = self._integrity_recover(
+                            iter_generator, verdict
+                        )
                     tel.note_lost(
                         "integrity_restore", time.monotonic() - rc_t0
                     )

@@ -58,6 +58,7 @@ class StepWatchdog:
         self.min_seconds = float(min_seconds)
         self.warmup = int(warmup)
         self.fires = 0  # guarded by: self._lock
+        self.resets = 0  # guarded by: self._lock
         self._times: deque = deque(maxlen=int(window))  # guarded by: self._lock
         self._on_hang = on_hang
         self._logger = logger
@@ -94,15 +95,19 @@ class StepWatchdog:
     def reset(self) -> None:
         """Forget trailing history and re-enter warmup.
 
-        For restart paths that recompile their programs (the serving hot
-        restart): the first post-rebuild steps legitimately take compile-
-        scale wall time, and judging them against the pre-restart median
-        would turn the recovery itself into another false hang.
+        For EVERY restart/recovery path that resumes stepping against a
+        cold pipeline — the serving hot restart, the training anomaly
+        rollback, and an integrity-snapshot restore: the first post-restore
+        steps legitimately take compile/replay-scale wall time, and judging
+        them against the pre-fault median would turn the recovery itself
+        into another false hang.  ``resets`` counts invocations so tests
+        pin that recovery paths actually call this.
         """
         with self._lock:
             self._times.clear()
             self._cur_step = None
             self._fired_for = None
+            self.resets += 1
 
     def trailing_median(self) -> Optional[float]:
         with self._lock:

@@ -48,6 +48,7 @@ from typing import Optional
 import threading
 
 from ..engine import fault
+from ..telemetry.spans import span
 
 __all__ = [
     "EngineRestartError",
@@ -136,7 +137,12 @@ class ServingSupervisor:
         """
         sched = self._sched
         if not _is_device_loss(exc) and sched._tick_phase == "decode":
-            if self._isolate(exc):
+            # span = the serve-side MTTR anchor (telemetry/slo.py): recovery
+            # start → first post-recovery decode tick
+            with span("poison_bisect", step=sched._tick_no,
+                      cause=type(exc).__name__):
+                isolated = self._isolate(exc)
+            if isolated:
                 return True
             self._logger.warning(
                 "decode failure not attributable to one request "
@@ -204,5 +210,7 @@ class ServingSupervisor:
             "hot-restarting serving engine after %s: %s (restart %d/%d)",
             type(cause).__name__, cause, self.restarts(), self.max_restarts,
         )
-        sched._rebuild_and_requeue()
+        with span("serving_restart", step=sched._tick_no,
+                  cause=type(cause).__name__):
+            sched._rebuild_and_requeue()
         return True

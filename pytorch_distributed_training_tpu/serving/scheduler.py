@@ -67,6 +67,7 @@ import numpy as np
 from ..engine import fault
 from ..engine.watchdog import StepWatchdog
 from ..telemetry.registry import get_registry
+from ..telemetry.spans import span
 from .batcher import OverloadedError
 from .decode import build_paged_fns
 from .kv_pool import PagedKVPool
@@ -544,6 +545,27 @@ class ContinuousScheduler:
                 pass
         if self._watchdog is not None:
             self._watchdog.close()
+        self._report_unfired_faults()
+
+    def _report_unfired_faults(self) -> None:
+        """Account injected serve-side faults still armed at close.
+
+        A one-shot fault scheduled for a tick this engine never reached
+        (drain deadline expired first, queue emptied early) would otherwise
+        vanish silently — the chaos oracle then mis-reads the scenario as
+        "fault recovered" when it never fired.  Count and log each leftover
+        so every injected fault ends the scenario as exactly one of
+        fired-and-recovered or reported-unfired.
+        """
+        pending = fault.get_injector().pending()
+        for kind, steps in pending.items():
+            if not (kind.startswith("serve_") or kind.startswith("replica_")):
+                continue
+            fault.bump(f"fault_unfired_{kind}", len(steps))
+            logging.getLogger(__name__).warning(
+                "scheduler closed with injected %s fault(s) still armed for "
+                "tick(s) %s — the engine never reached them", kind, steps,
+            )
 
     def __enter__(self):
         return self
@@ -1024,10 +1046,14 @@ class ContinuousScheduler:
         self._poison_shim(active)
         prev, pos, tables, gen_idx, keys = self._decode_arrays(active)
         n_active = len(active)
-        tok, finite, self._pool = self._fns.decode_step(
-            self.params, self._pool, prev, pos, tables,
-            jnp.stack(keys), gen_idx,
-        )
+        # the span marks this tick as PRODUCTIVE serving work — the
+        # serve-side MTTR endpoint (telemetry/slo.py pairs it with the
+        # preceding poison_bisect/serving_restart recovery span)
+        with span("decode_step", step=self._tick_no, active=n_active):
+            tok, finite, self._pool = self._fns.decode_step(
+                self.params, self._pool, prev, pos, tables,
+                jnp.stack(keys), gen_idx,
+            )
         tok = np.asarray(tok)
         finite = np.asarray(finite)
         t1 = time.perf_counter()

@@ -28,6 +28,7 @@ from .registry import (
 from .retrace import JitCacheProbe, get_probe, register_compiled
 from .runtime import Telemetry
 from .sinks import JsonlSink, LogSink, Sink, TensorBoardSink, summary_table
+from .slo import mttr_events, summarize_recoveries
 from .spans import SpanRecorder, get_recorder, set_recorder, span
 
 __all__ = [
@@ -47,10 +48,12 @@ __all__ = [
     "get_probe",
     "get_recorder",
     "get_registry",
+    "mttr_events",
     "parse_signal",
     "register_compiled",
     "reset_registry",
     "set_recorder",
     "span",
+    "summarize_recoveries",
     "summary_table",
 ]

@@ -1,0 +1,112 @@
+"""Recovery-SLO accounting: MTTR and recovery budgets from trace spans.
+
+The chaos soak engine (engine/chaos.py) holds every recovery ladder to a
+measured service-level objective, not just "it didn't crash".  The raw
+material is the span stream (spans.py): each recovery path brackets its
+work in a *recovery span* —
+
+    training : ``rollback`` (anomaly guard), ``integrity_restore``
+               (sentinel snapshot restore)
+    serving  : ``serving_restart`` (hot-restart + replay),
+               ``poison_bisect`` (culprit isolation)
+
+— and productive progress is marked by *productive spans*
+(``step_dispatch`` for training steps, ``decode_step`` for serving ticks).
+
+**MTTR** for one recovery event = wall time from the moment the fault was
+acted on (the recovery span's start — detection latency inside the step
+that tripped the guard is already part of that step, not the recovery) to
+the END of the first productive span that STARTS after the recovery span
+finished: the system is "recovered" when it has completed new useful work,
+not when the restore call returned.  A recovery with no later productive
+span (the run ended first) reports ``mttr_ms = None`` — callers treat
+that as a violation or as run-truncation depending on the scenario.
+
+Stdlib-only (telemetry core contract): works on the in-memory ring from
+``get_recorder().recent()`` and on parsed ``spans_rank<k>.jsonl`` lines
+alike, since both carry the same record dicts.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "PRODUCTIVE_SPAN_KINDS",
+    "RECOVERY_SPAN_KINDS",
+    "mttr_events",
+    "summarize_recoveries",
+]
+
+RECOVERY_SPAN_KINDS = (
+    "rollback",
+    "integrity_restore",
+    "serving_restart",
+    "poison_bisect",
+)
+
+PRODUCTIVE_SPAN_KINDS = (
+    "step_dispatch",
+    "decode_step",
+)
+
+
+def _end(rec: Dict) -> float:
+    return float(rec["t"]) + float(rec.get("ms", 0.0)) / 1e3
+
+
+def mttr_events(
+    records: Sequence[Dict],
+    recovery_kinds: Sequence[str] = RECOVERY_SPAN_KINDS,
+    productive_kinds: Sequence[str] = PRODUCTIVE_SPAN_KINDS,
+) -> List[Dict]:
+    """One event dict per recovery span found in ``records``.
+
+    Keys: ``kind``, ``step`` (the step/tick the recovery anchored to),
+    ``recovery_ms`` (the recovery span's own duration), ``mttr_ms``
+    (recovery start → end of first productive span starting after the
+    recovery finished; None when the run produced nothing afterwards).
+    Records need not be sorted; they are ordered by start time here.
+    """
+    recs = sorted(records, key=lambda r: float(r["t"]))
+    productive = [r for r in recs if r.get("kind") in set(productive_kinds)]
+    events: List[Dict] = []
+    for rec in recs:
+        if rec.get("kind") not in set(recovery_kinds):
+            continue
+        t_start, t_done = float(rec["t"]), _end(rec)
+        first_prod: Optional[Dict] = None
+        for p in productive:
+            if float(p["t"]) >= t_done:
+                first_prod = p
+                break
+        events.append({
+            "kind": rec["kind"],
+            "step": rec.get("step"),
+            "recovery_ms": round(float(rec.get("ms", 0.0)), 3),
+            "mttr_ms": (
+                round((_end(first_prod) - t_start) * 1e3, 3)
+                if first_prod is not None else None
+            ),
+        })
+    return events
+
+
+def summarize_recoveries(records: Sequence[Dict]) -> Dict:
+    """Aggregate SLO view over a run's spans (one scenario's worth).
+
+    ``events`` is the per-recovery list from :func:`mttr_events`;
+    ``mttr_ms_max``/``mttr_ms_mean`` aggregate the measured ones (None
+    when no recovery completed); ``unrecovered`` counts recovery spans
+    with no productive work after them.
+    """
+    events = mttr_events(records)
+    measured = [e["mttr_ms"] for e in events if e["mttr_ms"] is not None]
+    return {
+        "events": events,
+        "recoveries": len(events),
+        "unrecovered": sum(1 for e in events if e["mttr_ms"] is None),
+        "mttr_ms_max": max(measured) if measured else None,
+        "mttr_ms_mean": (
+            round(sum(measured) / len(measured), 3) if measured else None
+        ),
+    }

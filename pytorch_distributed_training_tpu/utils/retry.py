@@ -17,8 +17,14 @@ Design points:
     default) re-raise on the first attempt even when an allowlisted base
     class would otherwise catch them — retrying a deterministic bug only
     burns the attempt budget and delays the traceback,
-  - injectable ``sleep``/``rng`` so tests assert the exact delay sequence
-    without waiting on a wall clock.
+  - an optional *total deadline* (``total_timeout_s``) across all attempts:
+    stacked backoff must not outlive an external grace window (the spot
+    preemption SIGTERM→SIGKILL gap, an elastic peer-loss emergency save),
+    so when the NEXT backoff sleep would cross the deadline the policy
+    stops retrying and re-raises the last failure — classified like the
+    non-retryable path, plus a ``retry_deadline_exceeded`` counter,
+  - injectable ``sleep``/``rng``/``clock`` so tests assert the exact delay
+    sequence and deadline arithmetic without waiting on a wall clock.
 """
 from __future__ import annotations
 
@@ -45,6 +51,8 @@ class Retry:
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
         logger: Optional[logging.Logger] = None,
+        total_timeout_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {attempts}")
@@ -54,6 +62,10 @@ class Retry:
             )
         if not (0.0 <= jitter <= 1.0):
             raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        if total_timeout_s is not None and total_timeout_s <= 0:
+            raise ValueError(
+                f"total_timeout_s must be > 0, got {total_timeout_s}"
+            )
         self.attempts = int(attempts)
         self.backoff = float(backoff)
         self.max_backoff = float(max_backoff)
@@ -63,6 +75,10 @@ class Retry:
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
         self._logger = logger
+        self.total_timeout_s = (
+            float(total_timeout_s) if total_timeout_s is not None else None
+        )
+        self._clock = clock
 
     def delay(self, attempt: int) -> float:
         """Backoff before retry number ``attempt`` (0-based failed attempt)."""
@@ -74,8 +90,15 @@ class Retry:
 
         ``on_retry(attempt, exc, delay)`` fires before each backoff sleep
         (counter hooks); the final failure always re-raises the original
-        exception.
+        exception.  With ``total_timeout_s`` set, a retry whose backoff
+        sleep would land past the deadline is abandoned instead: the last
+        failure re-raises immediately (``retry_deadline_exceeded``), never
+        sleeping beyond the budget.
         """
+        deadline = (
+            self._clock() + self.total_timeout_s
+            if self.total_timeout_s is not None else None
+        )
         for attempt in range(self.attempts):
             try:
                 return fn(*args, **kwargs)
@@ -87,8 +110,20 @@ class Retry:
                 if attempt == self.attempts - 1:
                     self._count("retry_exhausted")
                     raise
-                self._count("retry_attempts")
                 d = self.delay(attempt)
+                if deadline is not None and self._clock() + d > deadline:
+                    self._count("retry_deadline_exceeded")
+                    if self._logger is not None:
+                        self._logger.warning(
+                            "%s failed (attempt %d/%d): %s — next backoff "
+                            "%.2fs would exceed the %.2fs total budget, "
+                            "abandoning retries",
+                            getattr(fn, "__name__", "call"),
+                            attempt + 1, self.attempts, exc, d,
+                            self.total_timeout_s,
+                        )
+                    raise
+                self._count("retry_attempts")
                 if on_retry is not None:
                     on_retry(attempt, exc, d)
                 if self._logger is not None:

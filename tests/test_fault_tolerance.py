@@ -168,6 +168,49 @@ def test_retry_non_retryable_classification():
     assert calls["n"] == 3  # retried to exhaustion
 
 
+def test_retry_total_timeout_bounds_stacked_backoff():
+    """total_timeout_s: stacked backoff must not outlive an external grace
+    window (spot SIGTERM->SIGKILL gap, elastic emergency save).  A retry
+    whose NEXT backoff sleep would cross the deadline re-raises the last
+    failure immediately instead of sleeping past the budget — fake clock
+    and sleep pin the arithmetic without wall time."""
+    from pytorch_distributed_training_tpu.telemetry import (
+        get_registry,
+        reset_registry,
+    )
+
+    now = {"t": 0.0}
+    slept = []
+
+    def fake_sleep(d):
+        slept.append(d)
+        now["t"] += d
+
+    reset_registry()
+    policy = Retry(
+        attempts=5, backoff=1.0, max_backoff=8.0, jitter=0.0,
+        total_timeout_s=2.0, sleep=fake_sleep, clock=lambda: now["t"],
+    )
+    calls = {"n": 0}
+
+    def broken_disk():
+        calls["n"] += 1
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        policy.call(broken_disk)
+    # attempt 0 fails -> backoff 1.0 fits (t=1.0); attempt 1 fails ->
+    # backoff 2.0 would land at t=3.0 > 2.0 -> abandon, re-raise
+    assert calls["n"] == 2
+    assert slept == [1.0]
+    reg = get_registry()
+    assert reg.counter("retry_deadline_exceeded").value == 1
+    assert reg.counter("retry_attempts").value == 1
+
+    with pytest.raises(ValueError, match="total_timeout_s"):
+        Retry(total_timeout_s=0.0)
+
+
 # ======================================================================
 # engine/fault.py — spec grammar and injector semantics
 # ======================================================================
@@ -236,6 +279,48 @@ def test_kill_peer_spec_parses_with_optional_rank():
     inj = FaultInjector("kill_peer@7:1")
     assert inj.take("kill_peer", 7) == 1.0
     assert inj.take("kill_peer", 7) is None  # one-shot
+
+
+def test_fault_spec_comma_separator_and_duplicate_rejection():
+    """The soak generator joins entries with ';' but hand-written specs
+    (env vars, YAML) often use ',' — both parse, mixed freely.  The same
+    kind@step twice is a spec bug (one-shot semantics make the second
+    entry dead) and must fail at parse time."""
+    inj = FaultInjector("nan_batch@2, kill_worker@4:1 ; stall_step@8:0.5")
+    assert inj.take("nan_batch", 2) == 1.0
+    assert inj.take("kill_worker", 4) == 1.0
+    assert inj.take("stall_step", 8) == 0.5
+    with pytest.raises(ValueError, match="duplicate"):
+        FaultInjector("nan_batch@2;nan_batch@2")
+    # same kind at DIFFERENT steps is the normal burst idiom
+    assert FaultInjector("nan_batch@2;nan_batch@3").active
+
+
+def test_injector_fired_and_pending_accounting():
+    """fired()/pending() partition the spec exactly — the soak engine's
+    accounting oracle (every armed fault fired, none left pending) reads
+    these, so their balance is pinned here."""
+    inj = FaultInjector("nan_batch@2;stall_step@5:0.1;ckpt_fail@0:2")
+    assert inj.fired() == {}
+    # fail-point entries account under their POINT name (ckpt_save), by
+    # the attempt ordinals still ahead of the process
+    assert inj.pending() == {
+        "nan_batch": [2], "stall_step": [5], "ckpt_save": [0, 1],
+    }
+    inj.take("nan_batch", 2)
+    with pytest.raises(FaultInjectionError):
+        inj.check_fail_point("ckpt_save")  # ordinal 0
+    assert inj.fired() == {"nan_batch": 1, "ckpt_save": 1}
+    assert inj.pending() == {"stall_step": [5], "ckpt_save": [1]}
+    with pytest.raises(FaultInjectionError):
+        inj.check_fail_point("ckpt_save")  # ordinal 1
+    inj.take("stall_step", 5)
+    assert inj.pending() == {}
+    assert inj.fired() == {"nan_batch": 1, "stall_step": 1, "ckpt_save": 2}
+    # per-kind trigger counters mirror into the process registry
+    c = fault.counters()
+    assert c.get("fault_fired_nan_batch") == 1
+    assert c.get("fault_fired_stall_step") == 1
 
 
 def test_fault_spec_config_key_validated_at_parse_time():
@@ -747,6 +832,132 @@ def test_serving_metrics_counters_in_snapshot():
     snap = m.snapshot()
     assert snap["timeouts"] == 2
     assert snap["sheds"] == 1
+
+
+# ======================================================================
+# compound-failure hardening (chaos soak regressions — engine/chaos.py)
+# ======================================================================
+@pytest.mark.chaos
+def test_emergency_save_bounded_when_async_write_wedged(tmp_path, monkeypatch):
+    """Compound #1: peer loss with an async checkpoint write in flight.
+    The emergency save's writer drain is bounded by
+    ``emergency_drain_timeout_s`` — a write wedged in a dead filesystem op
+    must not stall the peer-death escape hatch past the grace window.  The
+    emergency dump still commits (its own subdir, rank-stamped meta) and
+    the timeout is counted."""
+    from pytorch_distributed_training_tpu.engine.checkpoint import Checkpointer
+
+    ck = Checkpointer(
+        str(tmp_path / "ckpt"), interval=1, async_save=True,
+        emergency_drain_timeout_s=0.3,
+    )
+    monkeypatch.setattr(
+        Checkpointer, "_write_async",
+        lambda self, it, snapshot, extras: time.sleep(2.5),
+    )
+    state = {"params": np.arange(8, dtype=np.float32), "step": np.int64(4)}
+    ck.save(0, state)  # enqueues the (wedged) background write
+    t0 = time.monotonic()
+    ck.save_emergency(4, state)
+    wall = time.monotonic() - t0
+    assert wall < 2.0, f"emergency save blocked {wall:.2f}s on the writer"
+    assert fault.counters().get("emergency_drain_timeouts") == 1
+    assert ck.latest_emergency() == 4
+    emdir = tmp_path / "ckpt" / "emergency" / "4"
+    assert any(p.name.startswith("meta_rank") for p in emdir.iterdir())
+    ck.drain(raise_errors=False, timeout=5.0)  # let the wedge finish
+    ck.close()
+
+
+@pytest.mark.chaos
+def test_sdc_during_rollback_replay_restores_post_rollback_timeline(
+    tmp_path, one_device_mesh
+):
+    """Compound #2: an SDC flip lands DURING the anomaly-rollback replay.
+    The integrity sentinel must recover to the POST-rollback timeline (the
+    Runner rebases the retained snapshot after every rollback) — without
+    the rebase, the restore would resurrect pre-rollback state and the
+    final params/step would diverge from the flip-free run."""
+    def cfg_for(sub, spec):
+        cfg = _ft_cfg(
+            tmp_path / sub, train_iters=6, ckpt=True, interval=2,
+            fault_spec=spec,
+            anomaly={"enabled": True, "max_consecutive": 3},
+        )
+        cfg["training"]["integrity"] = {
+            "enabled": True, "check_interval": 6, "replicas": 3,
+            "max_consecutive": 2,
+        }
+        return cfg
+
+    burst = "nan_batch@2;nan_batch@3;nan_batch@4"
+    clean = _run(cfg_for("clean", burst))
+    want = jax.tree.map(np.asarray, clean.state.params)
+    assert fault.counters().get("rollbacks") == 1
+
+    fault.reset_counters()
+    # the flip fires at iter 5 — inside the replay that follows the
+    # rollback at iter 4 — and the step-5 integrity check catches it
+    runner = _run(cfg_for("flip", burst + ";sdc_flip@5:0"))
+    c = fault.counters()
+    assert c.get("rollbacks") == 1
+    assert c.get("injected_sdc_flips") == 1
+    assert c.get("integrity_transient_flips") == 1, (
+        "the sentinel never healed the replay-window flip"
+    )
+    assert int(runner.state.step) == int(clean.state.step)
+    got = jax.tree.map(np.asarray, runner.state.params)
+    for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.chaos
+def test_watchdog_reenters_warmup_after_rollback(tmp_path, one_device_mesh):
+    """Compound #4: the hung-step watchdog's trailing median survives a
+    rollback ONLY by being discarded — post-restore replay steps run cold
+    (recompiles) and judging them by the pre-fault median would turn the
+    recovery into another false hang.  The Runner must reset() the
+    watchdog on the rollback path; the reset re-enters warmup."""
+    cfg = _ft_cfg(
+        tmp_path, train_iters=6, ckpt=True, interval=2,
+        fault_spec="nan_batch@2;nan_batch@3;nan_batch@4",
+        anomaly={"enabled": True, "max_consecutive": 3},
+    )
+    cfg["training"]["fault_tolerance"]["watchdog"] = {
+        "enabled": True, "factor": 4.0, "min_seconds": 0.5,
+        "warmup": 3, "poll_seconds": 0.05,
+    }
+    runner = _run(cfg)
+    assert fault.counters().get("rollbacks") == 1
+    wd = runner._watchdog
+    assert wd is not None
+    assert wd.resets >= 1, "rollback did not reset the watchdog"
+    assert wd.fires == 0, "replay was misjudged as a hang"
+
+
+@pytest.mark.chaos
+def test_watchdog_reset_reenters_warmup_semantics():
+    """StepWatchdog.reset() drops the trailing window and the fired latch:
+    the very next steps are warmup samples, unjudged however slow."""
+    from pytorch_distributed_training_tpu.engine.watchdog import StepWatchdog
+
+    fired = []
+    with StepWatchdog(
+        factor=2.0, min_seconds=0.05, window=8, warmup=2, poll_seconds=0.02,
+        on_hang=lambda *a: fired.append(a),
+    ) as wd:
+        for i in range(2):
+            wd.step_started(i)
+            time.sleep(0.01)
+            wd.step_finished()
+        assert wd.trailing_median() is not None  # armed
+        wd.reset()
+        assert wd.resets == 1
+        assert wd.trailing_median() is None  # history gone -> warmup
+        wd.step_started(2)  # slow post-reset step: must NOT fire
+        time.sleep(0.3)
+        wd.step_finished()
+        assert wd.fires == 0 and not fired
 
 
 # ======================================================================

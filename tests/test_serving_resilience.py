@@ -457,6 +457,60 @@ def test_drain_deadline_bounds_shutdown(lm_and_params):
     sched._kv.check_invariants()
 
 
+def test_serve_nan_poison_during_drain(lm_and_params):
+    """Compound #3 (chaos soak): a poison fault that fires INSIDE the
+    drain(deadline_ms) window.  The drain loop must run the full bisect/
+    evict ladder mid-shutdown — exactly one future fails diagnosed, every
+    other request still completes, and the pool drains to empty."""
+    model, params = lm_and_params
+    fault.reset_counters()  # the registry is global; earlier tests leak
+    sched = _make_sched(model, params)
+    try:
+        futs = [sched.submit(p) for p in _prompts()]
+        sched.tick()  # admit; everything else happens inside drain()
+        fault.install(f"serve_nan@{sched._tick_no + 2}:0")
+        ms = sched.drain(deadline_ms=60_000)
+        assert ms >= 0.0
+        errs = [i for i, f in enumerate(futs) if f.exception() is not None]
+        assert errs == [0]
+        assert isinstance(futs[0].exception(), PoisonedRequestError)
+        for i in (1, 2):
+            assert futs[i].result()["gen_len"] == 6
+        snap = sched.metrics.snapshot()
+        assert snap["requests_poisoned"] == 1
+        c = fault.counters()
+        assert c.get("injected_serve_nans") == 1
+        assert c.get("fault_fired_serve_nan") == 1
+        assert sched._kv.blocks_in_use == 0
+        sched._kv.check_invariants()
+    finally:
+        fault.install(None)
+        fault.reset_counters()
+
+
+def test_unfired_serve_fault_reported_at_close(lm_and_params):
+    """A fault armed for a tick the engine never reaches (queue empties
+    first) must not vanish: close() reports it via ``fault_unfired_*`` so
+    the soak accounting oracle sees exactly fired-or-reported-unfired."""
+    model, params = lm_and_params
+    fault.reset_counters()  # the registry is global; earlier tests leak
+    fault.install("serve_nan@999:0")
+    try:
+        sched = _make_sched(model, params)
+        futs = [sched.submit(p) for p in _prompts()]
+        _drive(sched, futs)
+        for f in futs:
+            assert f.result()["gen_len"] == 6  # fault never fired
+        assert fault.get_injector().pending() == {"serve_nan": [999]}
+        sched.close()
+        c = fault.counters()
+        assert c.get("fault_unfired_serve_nan") == 1
+        assert not c.get("injected_serve_nans")
+    finally:
+        fault.install(None)
+        fault.reset_counters()
+
+
 def test_threaded_drain_under_load(lm_and_params):
     model, params = lm_and_params
     sched = ContinuousScheduler(
