@@ -98,6 +98,44 @@ def _spread_pct(dt_best: float, dt_median: float) -> float:
     return round(100.0 * (dt_median / dt_best - 1.0), 1)
 
 
+def _persist_serve_artifact(record: dict):
+    """Write one serving-bench record to the next ``BENCH_SERVE_r<NN>.json``.
+
+    The serving perf trajectory gets the same in-repo artifact treatment
+    as the training scoreboard (``BENCH_r<NN>.json``): one file per
+    recorded round, never rewritten.  The round number is the next free
+    one by default; ``BENCH_SERVE_ROUND=<NN>`` pins it, and a pinned
+    round that already exists is REFUSED — a recorded round is history,
+    not a slot.  ``BENCH_SERVE_ARTIFACT_DIR`` relocates (tests);
+    ``BENCH_SERVE_PERSIST=0`` skips persistence entirely.
+    """
+    import re
+
+    if os.environ.get("BENCH_SERVE_PERSIST", "1") == "0":
+        return None
+    art_dir = os.environ.get("BENCH_SERVE_ARTIFACT_DIR") or os.path.dirname(
+        os.path.abspath(__file__)
+    )
+    rounds = []
+    for f in os.listdir(art_dir):
+        m = re.fullmatch(r"BENCH_SERVE_r(\d+)\.json", f)
+        if m:
+            rounds.append(int(m.group(1)))
+    forced = os.environ.get("BENCH_SERVE_ROUND")
+    nn = int(forced) if forced else max(rounds, default=0) + 1
+    path = os.path.join(art_dir, f"BENCH_SERVE_r{nn:02d}.json")
+    if os.path.exists(path):
+        raise SystemExit(
+            f"refusing to clobber existing bench round {path}; drop "
+            f"BENCH_SERVE_ROUND (auto-picks the next free round) or pin "
+            f"an unused one"
+        )
+    with open(path, "w") as f:
+        json.dump(record, f)
+        f.write("\n")
+    return path
+
+
 def _make_jpeg_tree(root: str, n_images: int, size=(500, 375)) -> None:
     """Synthetic ImageNet-like JPEG tree: smooth images at photo-typical
     resolution/quality so libjpeg decode cost matches real data."""
@@ -823,9 +861,7 @@ def bench_serve():
         compile_count = engine.compile_count()
 
     task = "lm tokens" if engine.is_lm else "images"
-    print(
-        json.dumps(
-            {
+    record = {
                 "metric": f"serving {task}/sec ({os.path.basename(cfg_path)}, "
                 f"{n_requests} reqs @ {rate}/s open-loop)",
                 "value": round(snap.get("items_per_sec", 0.0), 1),
@@ -877,9 +913,184 @@ def bench_serve():
                     if "prefill_tokens_per_sec" in snap
                     else {}
                 ),
+    }
+    print(json.dumps(record))
+    art = _persist_serve_artifact({"mode": "serve", **record})
+    if art:
+        print(f"bench round recorded: {art}", file=sys.stderr)
+
+
+def bench_serve_modes():
+    """Multi-tenant serving A/B: baseline vs quant vs LoRA vs speculative.
+
+    One engine build + one open-loop stream per mode over the SAME
+    request trace (same prompts, same arrival times, same caps), all on
+    the continuous-scheduler path — the only knob that changes between
+    runs is the ``serving.quant`` / ``serving.lora`` /
+    ``serving.speculative`` block under test, so the decode tok/s and
+    latency deltas are the mode's own.  One JSON line with the per-mode
+    table and vs-baseline ratios, persisted to the next
+    ``BENCH_SERVE_r<NN>.json`` round.
+
+      BENCH_SERVE_CONFIG        serve-*.yml (default config/serve-lm.yml)
+      BENCH_SERVE_REQUESTS      requests per mode (default 48)
+      BENCH_SERVE_RATE          arrivals/sec; 0 = all at once (default 0:
+                                saturate the scheduler so decode tok/s is
+                                the bottleneck being compared)
+      BENCH_SERVE_MODES         comma list from baseline,quant,lora,
+                                speculative (default: all four)
+      BENCH_SERVE_SPEC_K        speculative draft length (default 4)
+      BENCH_SERVE_SPEC_DEPTH    draft model depth override (default 1)
+    """
+    import copy
+
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.config_parsing import get_serve_cfg
+    from pytorch_distributed_training_tpu.serving import InferenceEngine
+
+    cfg_path = os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "48"))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "0"))
+    spec_k = int(os.environ.get("BENCH_SERVE_SPEC_K", "4"))
+    spec_depth = int(os.environ.get("BENCH_SERVE_SPEC_DEPTH", "1"))
+    modes = [
+        m.strip()
+        for m in os.environ.get(
+            "BENCH_SERVE_MODES", "baseline,quant,lora,speculative"
+        ).split(",")
+        if m.strip()
+    ]
+    adapters = ["tenant-a", "tenant-b"]
+    overlays = {
+        "baseline": {},
+        "quant": {"quant": {"enabled": True}},
+        "lora": {
+            "lora": {"enabled": True, "rank": 8, "adapters": list(adapters)}
+        },
+        "speculative": {
+            "speculative": {
+                "enabled": True, "k": spec_k, "draft": {"depth": spec_depth},
             }
+        },
+    }
+    unknown = [m for m in modes if m not in overlays]
+    if unknown:
+        raise SystemExit(f"unknown BENCH_SERVE_MODES entries: {unknown}")
+
+    base_cfg = get_serve_cfg(cfg_path)
+    # every mode under comparison runs the continuous scheduler (LoRA and
+    # speculative REQUIRE it; forcing it for baseline/quant keeps the A/B
+    # apples-to-apples)
+    sched = dict(base_cfg["serving"].get("scheduler") or {})
+    sched["enabled"] = True
+    base_cfg["serving"]["scheduler"] = sched
+    if not base_cfg["serving"].get("checkpoint"):
+        # silence the random-init warning once; each mode re-inits from
+        # the same seed so all four engines serve identical weights
+        import logging
+
+        logging.getLogger(
+            "pytorch_distributed_training_tpu.serving.engine"
+        ).setLevel(logging.ERROR)
+
+    # one shared request trace: same prompts in the same order per mode
+    rng = np.random.default_rng(0)
+    vocab = base_cfg["dataset"]["n_classes"]
+    max_prompt = max(int(s) for s in base_cfg["serving"].get("seq_buckets", [16]))
+    prompts = [
+        rng.integers(0, vocab, int(rng.integers(1, max_prompt + 1))).astype(
+            np.int32
         )
-    )
+        for _ in range(n_requests)
+    ]
+
+    results = {}
+    for mode in modes:
+        cfg = copy.deepcopy(base_cfg)
+        cfg["serving"].update(copy.deepcopy(overlays[mode]))
+        with InferenceEngine.from_config(cfg) as engine:
+            # warm EVERY bucket outside the timed stream (a shortest and a
+            # longest prompt cover the whole seq-bucket grid) — otherwise
+            # whichever mode first hits a cold bucket pays its compile
+            # inside the timed window and the A/B compares compile times
+            for wp_len in (1, max_prompt):
+                engine.submit(
+                    np.full((wp_len,), 2, np.int32),
+                    adapter=adapters[0] if mode == "lora" else None,
+                ).result(timeout=600)
+            engine.metrics = type(engine.metrics)()
+            engine.scheduler.metrics = engine.metrics
+
+            t0 = time.perf_counter()
+            futures = []
+            for i, p in enumerate(prompts):
+                if rate > 0:
+                    lag = t0 + i / rate - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                # lora mode: requests round-robin the tenants, with every
+                # third request on the base model (the multiplexed batch
+                # the registry exists for)
+                adapter = None
+                if mode == "lora" and i % 3 != 2:
+                    adapter = adapters[i % 3]
+                futures.append(engine.submit(p, adapter=adapter))
+            for fut in futures:
+                fut.result(timeout=600)
+            wall_s = time.perf_counter() - t0
+            snap = engine.metrics.snapshot()
+            results[mode] = {
+                "decode_tokens_per_sec": round(
+                    snap.get("decode_tokens_per_sec", 0.0), 1
+                ),
+                "prefill_tokens_per_sec": round(
+                    snap.get("prefill_tokens_per_sec", 0.0), 1
+                ),
+                "items_per_sec": round(snap.get("items_per_sec", 0.0), 1),
+                "latency_ms_p50": round(snap.get("latency_ms_p50", 0.0), 2),
+                "latency_ms_p99": round(snap.get("latency_ms_p99", 0.0), 2),
+                "gen_tokens": snap.get("gen_tokens", 0),
+                "compile_count": engine.compile_count(),
+                "wall_s": round(wall_s, 2),
+                **(
+                    {
+                        "spec_acceptance_rate": round(
+                            snap["spec_acceptance_rate"], 3
+                        )
+                    }
+                    if "spec_acceptance_rate" in snap else {}
+                ),
+                **(
+                    {
+                        f"adapter_{a}_gen_tokens": snap.get(
+                            f"adapter_{a}_gen_tokens", 0
+                        )
+                        for a in adapters
+                    }
+                    if mode == "lora" else {}
+                ),
+            }
+
+    base_tps = results.get("baseline", {}).get("decode_tokens_per_sec", 0.0)
+    for mode, r in results.items():
+        r["decode_vs_baseline"] = (
+            round(r["decode_tokens_per_sec"] / base_tps, 3)
+            if base_tps and mode != "baseline" else None
+        )
+    record = {
+        "metric": f"multi-tenant serving decode tok/s A/B "
+        f"({os.path.basename(cfg_path)}, {n_requests} reqs/mode @ "
+        f"{rate if rate > 0 else 'burst'}/s, modes {'+'.join(modes)})",
+        "value": results.get(modes[-1], {}).get("decode_tokens_per_sec", 0.0),
+        "unit": "decode tokens/sec",
+        "vs_baseline": results.get(modes[-1], {}).get("decode_vs_baseline"),
+        "modes": results,
+    }
+    print(json.dumps(record))
+    art = _persist_serve_artifact({"mode": "serve-modes", **record})
+    if art:
+        print(f"bench round recorded: {art}", file=sys.stderr)
 
 
 def bench_ckpt():
@@ -2241,28 +2452,28 @@ def bench_soak():
         }
         for r in summary["results"]
     ]
-    print(
-        json.dumps(
-            {
-                "metric": f"chaos soak: {n} seeded multi-fault scenarios "
-                "(oracle-judged), scenarios passed",
-                "value": summary["passed"],
-                "unit": "scenarios",
-                "seed": summary["seed"],
-                "families": summary["families"],
-                "failed": summary["failed"],
-                "skipped": summary["skipped"],
-                "mttr_ms_max": summary["mttr_ms_max"],
-                "mttr_ms_mean": summary["mttr_ms_mean"],
-                "goodput_floor": summary["goodput_floor"],
-                "kinds_exercised": summary["kinds_exercised"],
-                "kinds_uncovered": summary["kinds_uncovered"],
-                "coverage": summary["coverage"],
-                "results": compact,
-                "wall_s": round(time.monotonic() - t0, 1),
-            }
-        )
-    )
+    record = {
+        "metric": f"chaos soak: {n} seeded multi-fault scenarios "
+        "(oracle-judged), scenarios passed",
+        "value": summary["passed"],
+        "unit": "scenarios",
+        "seed": summary["seed"],
+        "families": summary["families"],
+        "failed": summary["failed"],
+        "skipped": summary["skipped"],
+        "mttr_ms_max": summary["mttr_ms_max"],
+        "mttr_ms_mean": summary["mttr_ms_mean"],
+        "goodput_floor": summary["goodput_floor"],
+        "kinds_exercised": summary["kinds_exercised"],
+        "kinds_uncovered": summary["kinds_uncovered"],
+        "coverage": summary["coverage"],
+        "results": compact,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(record))
+    art = _persist_serve_artifact({"mode": "soak", **record})
+    if art:
+        print(f"bench round recorded: {art}", file=sys.stderr)
     if summary["failed"]:
         for r in summary["results"]:
             if not r["ok"]:
@@ -2352,6 +2563,8 @@ if __name__ == "__main__":
         bench_overlap()
     elif mode in ("serve", "--serve"):
         bench_serve()
+    elif mode in ("serve-modes", "--serve-modes"):
+        bench_serve_modes()
     elif mode in ("chaos", "--chaos"):
         bench_chaos()
     elif mode in ("chaos-serve", "--chaos-serve"):

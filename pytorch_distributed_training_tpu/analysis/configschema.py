@@ -61,6 +61,9 @@ _ROOT_PARAMS = {"cfg": (), "train_cfg": ("training",)}
 _SEED_PARAMS = {
     "scheduler": ("serving", "scheduler"),
     "resilience": ("serving", "resilience"),
+    "quant": ("serving", "quant"),
+    "lora": ("serving", "lora"),
+    "speculative": ("serving", "speculative"),
 }
 _ACCESS_METHODS = {"get", "pop", "setdefault"}
 _CASTS = {"int", "float", "bool", "str"}

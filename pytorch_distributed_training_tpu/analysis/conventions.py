@@ -57,6 +57,7 @@ BENCH_DRIVERS = (
     "bench_chaos_fleet(",
     "bench_fleet_serve(",
     "bench_soak(",
+    "bench_serve_modes(",
 )
 
 FAULT_MACHINERY = (

@@ -86,9 +86,14 @@ class DecoderBlock(nn.Module):
     # single Pallas kernels (ops/fused_elementwise.py).  Same parameter
     # tree either way (checkpoint-compatible); off by default.
     fused_tails: bool = False
+    # Multi-LoRA serving (serving/lora.py): stacked per-adapter low-rank
+    # factors on the attention qkv/proj Denses, selected per batch row
+    # via ``adapter_ids`` — see MultiHeadAttention.lora_rank.
+    lora_rank: int = 0
+    lora_adapters: int = 0
 
     @nn.compact
-    def __call__(self, x, decode_pos=None, block_tables=None):
+    def __call__(self, x, decode_pos=None, block_tables=None, adapter_ids=None):
         dim = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         attn_out = MultiHeadAttention(
@@ -103,8 +108,10 @@ class DecoderBlock(nn.Module):
             paged=self.paged,
             kv_block_size=self.kv_block_size,
             kv_num_blocks=self.kv_num_blocks,
+            lora_rank=self.lora_rank,
+            lora_adapters=self.lora_adapters,
             name="attn",
-        )(y, decode_pos, block_tables)
+        )(y, decode_pos, block_tables, adapter_ids)
         if self.fused_tails and self.moe_experts == 0:
             from ..ops.fused_elementwise import FusedResidualLayerNorm
 
@@ -192,9 +199,16 @@ class TransformerLM(nn.Module):
     paged: bool = False
     kv_block_size: int = 0
     kv_num_blocks: int = 0
+    # Multi-LoRA multiplexing (serving/lora.py): ``lora_rank > 0`` adds
+    # stacked per-adapter factors to every block's attention qkv/proj
+    # ([lora_adapters, ...] leaves in the params tree; base shapes are
+    # unchanged, so plain checkpoints still restore).  ``adapter_ids``
+    # [B] int32 selects each row's adapter per call; -1 = base model.
+    lora_rank: int = 0
+    lora_adapters: int = 0
 
     @nn.compact
-    def __call__(self, tokens, decode_pos=None, block_tables=None):
+    def __call__(self, tokens, decode_pos=None, block_tables=None, adapter_ids=None):
         if self.moe_experts > 0 and self.moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
         if self.decode and self.seq_axis is not None:
@@ -207,6 +221,11 @@ class TransformerLM(nn.Module):
             raise ValueError("paged KV mode requires decode=True")
         if self.paged and decode_pos is not None and block_tables is None:
             raise ValueError("paged KV mode needs block_tables alongside decode_pos")
+        if adapter_ids is not None and self.lora_rank <= 0:
+            raise ValueError(
+                "adapter_ids given but the model has no LoRA factors "
+                "(clone with lora_rank/lora_adapters set)"
+            )
         b, s = tokens.shape
         emb = self.param(
             "tok_embedding",
@@ -284,7 +303,9 @@ class TransformerLM(nn.Module):
                 kv_block_size=self.kv_block_size,
                 kv_num_blocks=self.kv_num_blocks,
                 fused_tails=self.fused_tails,
+                lora_rank=self.lora_rank,
+                lora_adapters=self.lora_adapters,
                 name=f"block{i}",
-            )(x, decode_pos, block_tables)
+            )(x, decode_pos, block_tables, adapter_ids)
         x = nn.LayerNorm(dtype=self.dtype, name="ln")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(x)

@@ -220,14 +220,52 @@ class MultiHeadAttention(nn.Module):
     paged: bool = False
     kv_block_size: int = 0
     kv_num_blocks: int = 0
+    # Multi-LoRA serving (serving/lora.py + ops/lora.py): with
+    # ``lora_rank > 0`` the qkv and proj Denses each carry STACKED
+    # low-rank factors for ``lora_adapters`` adapters ([N, din, r] /
+    # [N, r, dout] in the regular params tree — grafted from the adapter
+    # registry at engine build), and ``adapter_ids`` [B] selects each
+    # row's adapter per call (-1 = base model, zero delta).  Base
+    # parameter shapes are unchanged, so train-time checkpoints still
+    # restore directly.
+    lora_rank: int = 0
+    lora_adapters: int = 0
 
     @nn.compact
-    def __call__(self, x, decode_pos=None, block_tables=None):
+    def __call__(self, x, decode_pos=None, block_tables=None, adapter_ids=None):
         b, s, dim = x.shape
         if dim % self.num_heads != 0:
             raise ValueError(f"embed dim {dim} not divisible by {self.num_heads} heads")
+        if self.lora_rank > 0 and self.lora_adapters < 1:
+            raise ValueError(
+                f"lora_rank {self.lora_rank} needs lora_adapters >= 1, "
+                f"got {self.lora_adapters}"
+            )
+        if adapter_ids is not None and self.lora_rank <= 0:
+            raise ValueError(
+                "adapter_ids given but the module has no LoRA factors "
+                "(lora_rank is 0)"
+            )
         head_dim = dim // self.num_heads
         qkv = nn.Dense(3 * dim, dtype=self.dtype, name="qkv")(x)
+        if self.lora_rank > 0:
+            from .lora import lora_delta
+
+            # B zero-init: a freshly-initialized adapter is an exact
+            # no-op, the standard LoRA construction; real factors are
+            # grafted over these leaves by the serving registry
+            qkv_a = self.param(
+                "qkv_lora_a", nn.initializers.normal(stddev=0.02),
+                (self.lora_adapters, dim, self.lora_rank), jnp.float32,
+            )
+            qkv_b = self.param(
+                "qkv_lora_b", nn.initializers.zeros,
+                (self.lora_adapters, self.lora_rank, 3 * dim), jnp.float32,
+            )
+            if adapter_ids is not None:
+                qkv = qkv + lora_delta(x, qkv_a, qkv_b, adapter_ids).astype(
+                    qkv.dtype
+                )
         # heads-major layout: the flat 3*dim output factors as (H, 3, hd), so
         # sharding the qkv kernel's output axis over a model mesh axis (k | H)
         # splits on whole-head boundaries and GSPMD propagates it through this
@@ -250,7 +288,23 @@ class MultiHeadAttention(nn.Module):
         else:
             raise ValueError(f"unknown seq_impl {self.seq_impl!r}")
         out = out.reshape(b, s, dim)
-        return nn.Dense(dim, dtype=self.dtype, name="proj")(out)
+        proj = nn.Dense(dim, dtype=self.dtype, name="proj")(out)
+        if self.lora_rank > 0:
+            from .lora import lora_delta
+
+            proj_a = self.param(
+                "proj_lora_a", nn.initializers.normal(stddev=0.02),
+                (self.lora_adapters, dim, self.lora_rank), jnp.float32,
+            )
+            proj_b = self.param(
+                "proj_lora_b", nn.initializers.zeros,
+                (self.lora_adapters, self.lora_rank, dim), jnp.float32,
+            )
+            if adapter_ids is not None:
+                proj = proj + lora_delta(
+                    out, proj_a, proj_b, adapter_ids
+                ).astype(proj.dtype)
+        return proj
 
     def _decode_attention(self, q, k, v, decode_pos):
         """Prefill / single-step attention against the KV cache."""

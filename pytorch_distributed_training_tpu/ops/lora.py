@@ -1,0 +1,53 @@
+"""Batched multi-adapter LoRA delta: one gather-einsum per projection.
+
+Multi-LoRA serving (serving/lora.py) batches requests of MANY adapters
+against ONE base model in the same decode step.  The adapter weights for
+a projection live STACKED — ``A [N, din, r]``, ``B [N, r, dout]`` for
+``N`` adapters of rank ``r`` — inside the regular params tree, and each
+batch row carries its adapter id.  The low-rank path is then two
+einsums over the per-row gathered factors:
+
+    delta[b] = (x[b] @ A[ids[b]]) @ B[ids[b]]
+
+which XLA lowers to a gather + two batched matmuls — no per-adapter
+program, no host-side weight swapping, and the program shape is
+independent of which adapters the current rows use (the compile-count
+pin's requirement).  ``N`` is static per compile (the registry is fixed
+at engine build).
+
+Adapter id ``-1`` means "no adapter" (the base model): the gather clamps
+to row 0 and the delta is masked to zero, so base-model and adapter
+rows coexist in one batch.
+
+The math deliberately matches the merged-weights construction
+``x @ (W + A_k B_k) = x @ W + (x @ A_k) @ B_k`` term for term in f32 —
+the multi-LoRA parity oracle (tests/test_serving.py) pins the decode
+TOKEN stream of this path against an engine serving the merged kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["lora_delta"]
+
+
+def lora_delta(x, a_stack, b_stack, adapter_ids):
+    """Per-row low-rank delta ``[B, S, dout]``.
+
+    ``x`` [B, S, din]; ``a_stack`` [N, din, r]; ``b_stack`` [N, r, dout];
+    ``adapter_ids`` [B] int32 (-1 = no adapter -> zero delta).  Computed
+    in f32 regardless of input dtype (rank is tiny, the cost is noise)
+    and cast back to ``x.dtype`` by the caller if needed.
+    """
+    if a_stack.ndim != 3 or b_stack.ndim != 3:
+        raise ValueError(
+            f"stacked LoRA factors must be [N, din, r]/[N, r, dout], got "
+            f"{a_stack.shape}/{b_stack.shape}"
+        )
+    safe = jnp.maximum(adapter_ids, 0)
+    a = a_stack[safe].astype(jnp.float32)  # [B, din, r]
+    b = b_stack[safe].astype(jnp.float32)  # [B, r, dout]
+    xr = jnp.einsum("bsd,bdr->bsr", x.astype(jnp.float32), a)
+    delta = jnp.einsum("bsr,bro->bso", xr, b)
+    mask = (adapter_ids >= 0)[:, None, None]
+    return jnp.where(mask, delta, 0.0)

@@ -1,0 +1,103 @@
+"""Per-channel symmetric int8 weight quantization for the decode path.
+
+Decode is memory-bound: every single-token step streams the full weight
+set through the matmul units for a trivial amount of compute, so halving
+the weight bytes (bf16/f32 -> int8 + one f32 scale per output channel)
+is the direct lever on decode tokens/sec — the serving analogue of the
+training side's mixed-precision stance.  Prefill stays in the serving
+dtype (it is compute-bound and amortizes the weights over the whole
+prompt), which is why quantization lives here as a PARAMS-TREE transform
+applied once at engine build rather than as a model flag: the decode jit
+programs receive the quantized tree and dequantize in-graph
+(``W ~= q.astype(compute) * s``), weights rest in device memory as int8,
+and XLA fuses the dequant into the consuming matmul.
+
+Scope: every 2-D ``kernel`` leaf (the Dense matmul weights — qkv, proj,
+fc1/fc2, head, and the stacked LoRA factors ride through untouched
+because they are 3-D).  Embeddings, biases, and LayerNorm scales stay in
+their original dtype: they are small, and the token-embedding gather is
+not a matmul.
+
+Symmetric per-OUTPUT-channel scales (one f32 per column of a
+``[din, dout]`` kernel): ``s_j = max_i |W_ij| / 127``, ``q = round(W/s)``
+clipped to [-127, 127].  Symmetric (no zero point) keeps the dequant a
+single fused multiply; per-channel absorbs the order-of-magnitude spread
+between channels that a per-tensor scale would round away.
+
+A quantized leaf is the dict ``{"q": int8 [din, dout], "s": f32 [1, dout]}``
+in place of the kernel array — the tree STRUCTURE changes, so quantized
+and plain trees are never confused silently; :func:`dequantize_tree`
+restores the original structure (with rounding error) in-graph.
+"""
+from __future__ import annotations
+
+from collections.abc import Mapping
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_tree", "dequantize_tree", "is_quantized_leaf"]
+
+_QKEYS = frozenset(("q", "s"))
+
+
+def _leaf_name(path) -> str:
+    part = path[-1]
+    return str(getattr(part, "key", getattr(part, "name", "")))
+
+
+def _should_quantize(path, leaf) -> bool:
+    return (
+        _leaf_name(path) == "kernel"
+        and hasattr(leaf, "ndim")
+        and leaf.ndim == 2
+        and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    )
+
+
+def quantize_leaf(w):
+    """One ``[din, dout]`` kernel -> ``{"q": int8, "s": f32 [1, dout]}``."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0, keepdims=True)  # [1, dout]
+    # an all-zero channel has amax 0; its q rows are 0 regardless, so any
+    # nonzero scale dequantizes it exactly — avoid the 0/0
+    s = jnp.where(amax > 0, amax, 1.0) / 127.0
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def is_quantized_leaf(node) -> bool:
+    return isinstance(node, Mapping) and set(node) == _QKEYS
+
+
+def quantize_tree(params):
+    """Quantize every 2-D ``kernel`` leaf of a params tree (host/device
+    side, once at engine build); everything else passes through by
+    reference."""
+
+    def visit(path, leaf):
+        if _should_quantize(path, leaf):
+            return quantize_leaf(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)
+
+
+def dequantize_tree(qparams, dtype):
+    """In-graph inverse: rebuild a plain params tree in ``dtype``.
+
+    Called INSIDE the decode jit programs (serving/decode.py) so the
+    device-resident tree stays int8 and the dequant multiply fuses into
+    each consuming matmul.
+    """
+
+    def visit(node):
+        if is_quantized_leaf(node):
+            return (
+                node["q"].astype(jnp.float32) * node["s"]
+            ).astype(dtype)
+        if isinstance(node, Mapping):
+            return {k: visit(v) for k, v in node.items()}
+        return node
+
+    return visit(qparams)

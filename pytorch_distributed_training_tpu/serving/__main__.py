@@ -61,11 +61,13 @@ def main(argv=None) -> int:
             # orchestrated-shutdown path, wired here because signal
             # handlers must install from the main thread
             engine.install_drain_handler()
+            modes = [m for m, on in engine.serving_modes.items() if on]
             logger.info(
-                "engine up: task=%s batch_buckets=%s seq_buckets=%s",
+                "engine up: task=%s batch_buckets=%s seq_buckets=%s modes=%s",
                 "lm" if engine.is_lm else "image",
                 engine.batch_buckets,
                 engine.seq_buckets if engine.is_lm else "-",
+                "+".join(modes) if modes else "baseline",
             )
             futures = [
                 engine.submit(p)

@@ -31,6 +31,28 @@ token (the sampled-mode half of the decode-parity oracle).
 ``ops/attention.py``: one prefill program per (batch, seq) bucket and ONE
 single-token step program shared by every decode iteration, both over a
 pool pytree threaded through the calls instead of a per-batch cache.
+
+Multi-tenant decode modes (PR 17), all default-off:
+
+  - ``quant=True`` (ops/quant.py): the DECODE programs expect the
+    int8-quantized params tree and dequantize in-graph — weights rest in
+    device memory at half/quarter the bytes, which is what memory-bound
+    decode streams every step.  Prefill (compute-bound) keeps the plain
+    tree, so each builder's two phases take DIFFERENT trees in quant
+    mode; the engine/scheduler hold both.
+  - ``adapter_ids`` (ops/lora.py): every paged program takes the per-row
+    adapter-id array; it reaches the model only when the model was
+    cloned with LoRA factors (-1 rows run the base model), so non-LoRA
+    builds trace it as an ignored input and program counts are
+    unchanged.
+  - ``verify`` (serving/speculative.py): a prefill-shaped program that
+    returns the FULL per-position logits instead of sampling one token —
+    the target model scores a draft's k proposals in one batched step
+    and the host does exact accept/reject on the logits.
+  - ``copy_rows``: pool row gather/scatter for the speculative branch
+    fork — copies a boundary block's committed rows into the branch's
+    spare block (serving/kv_pool.py fork pattern) in one fixed-shape
+    program.
 """
 from __future__ import annotations
 
@@ -38,6 +60,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..ops.quant import dequantize_tree
 
 __all__ = ["build_generate_fn", "build_paged_fns"]
 
@@ -77,16 +101,19 @@ class _GenerateFn:
     cache from the padded prompts and samples generated token 0.
     ``decode(params, prompt_len, carry) -> (out_tokens, gen_len)`` — the
     EOS-early-exit while_loop over single-token steps.
-    ``__call__`` chains them, matching the pre-split ``generate`` contract.
+    ``__call__`` chains them, matching the pre-split ``generate`` contract
+    (``decode_params`` overrides the tree the decode phase gets — the
+    int8 tree when the builder was made with ``quant=True``).
     """
 
     def __init__(self, prefill, decode):
         self.prefill = prefill
         self.decode = decode
 
-    def __call__(self, params, tokens, prompt_len, rng):
+    def __call__(self, params, tokens, prompt_len, rng, decode_params=None):
         carry = self.prefill(params, tokens, prompt_len, rng)
-        return self.decode(params, prompt_len, carry)
+        dp = params if decode_params is None else decode_params
+        return self.decode(dp, prompt_len, carry)
 
     def _cache_size(self) -> int:
         """Total distinct XLA programs compiled (both phases) — feeds the
@@ -99,6 +126,7 @@ def build_generate_fn(
     max_new_tokens: int,
     temperature: float = 0.0,
     eos_id: Optional[int] = None,
+    quant: bool = False,
 ):
     """Compile ``generate(params, tokens, prompt_len, rng)``.
 
@@ -114,6 +142,10 @@ def build_generate_fn(
 
     ``temperature == 0.0`` (static) is greedy argmax and ignores ``rng``;
     otherwise tokens are drawn from ``softmax(logits / temperature)``.
+
+    ``quant=True``: the DECODE program's ``params`` argument is the
+    int8-quantized tree (ops/quant.quantize_tree) and is dequantized
+    in-graph; prefill still takes the plain tree.
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -157,6 +189,8 @@ def build_generate_fn(
 
     @jax.jit
     def decode(params, prompt_len, carry):
+        if quant:
+            params = dequantize_tree(params, jnp.float32)
         cache0, tok0, out0, done0, gen_len0, row_keys0 = carry
 
         def cond(c):
@@ -189,37 +223,55 @@ def build_generate_fn(
 
 
 class _PagedFns:
-    """Jit pair + pool factory for the paged (block-table) cache mode.
+    """Jit set + pool factory for the paged (block-table) cache mode.
 
     ``prefill(params, pool, tokens, positions, block_tables, last_col,
-    row_keys, gen_index) -> (tok, finite, pool)`` — scatter the suffix K/V
-    into the pool and sample each row's token ``gen_index[r]`` from the
-    logits at ``last_col`` (0 for a fresh prompt; the hot-restart replay
-    path passes the index of the last already-delivered token so the
-    resample is bitwise reproducible).
+    row_keys, gen_index, adapter_ids) -> (tok, finite, pool)`` — scatter
+    the suffix K/V into the pool and sample each row's token
+    ``gen_index[r]`` from the logits at ``last_col`` (0 for a fresh
+    prompt; the hot-restart replay path passes the index of the last
+    already-delivered token so the resample is bitwise reproducible).
     ``decode_step(params, pool, prev_tok, pos, block_tables, row_keys,
-    gen_index) -> (tok, finite, pool)`` — ONE single-token step for every
-    slot; the scheduler's host loop supplies fresh inputs per iteration,
-    so this one program serves any mix of in-flight requests.
+    gen_index, adapter_ids) -> (tok, finite, pool)`` — ONE single-token
+    step for every slot; the scheduler's host loop supplies fresh inputs
+    per iteration, so this one program serves any mix of in-flight
+    requests.  In quant mode ``params`` here is the int8 tree.
     ``finite`` [B] bool is the on-device output guard: True iff every
     logit the row sampled from is finite — the serving mirror of the
     training anomaly guard, letting the scheduler evict a NaN-producing
     request without a Python exception (padding rows read stale pool
     rows, so only ACTIVE rows' flags are meaningful).
+    ``verify(params, pool, tokens, positions, block_tables, adapter_ids)
+    -> (logits [B, S, V] f32, pool)`` — the speculative-decoding scoring
+    program: prefill-shaped (scatters the fed tokens' K/V), but returns
+    EVERY position's logits so the host can accept/reject a draft's k
+    proposals from one call.  Always takes the PLAIN params tree, even
+    in quant mode: verification is the accuracy anchor.
+    ``copy_rows(pool, src, dst) -> pool`` — copy pool rows ``src[i]`` to
+    ``dst[i]`` across every cache leaf (OOB ``dst`` entries drop): the
+    speculative fork's boundary-block CoW into the spare block.
     ``init_pool(params)`` — the zero pool pytree (``jax.eval_shape`` over
     the apply: correct flax cache paths, no throwaway compile).
     """
 
-    def __init__(self, prefill, decode_step, init_pool):
+    def __init__(self, prefill, decode_step, init_pool, verify, copy_rows):
         self.prefill = prefill
         self.decode_step = decode_step
         self.init_pool = init_pool
+        self.verify = verify
+        self.copy_rows = copy_rows
 
     def _cache_size(self) -> int:
-        """Distinct XLA programs compiled across both phases — the
+        """Distinct XLA programs compiled across all phases — the
         scheduler's compile count is bounded by the bucket grid for
-        prefill plus ONE decode program, independent of traffic."""
-        return self.prefill._cache_size() + self.decode_step._cache_size()
+        prefill plus ONE program each for decode/verify/copy, independent
+        of traffic."""
+        return (
+            self.prefill._cache_size()
+            + self.decode_step._cache_size()
+            + self.verify._cache_size()
+            + self.copy_rows._cache_size()
+        )
 
 
 def build_paged_fns(
@@ -227,8 +279,9 @@ def build_paged_fns(
     block_size: int,
     num_blocks: int,
     temperature: float = 0.0,
+    quant: bool = False,
 ):
-    """Compile the paged prefill/decode pair over a shared block pool.
+    """Compile the paged prefill/decode/verify set over a shared block pool.
 
     Shapes are the scheduler's contract: ``tokens``/``positions`` are
     [B, S] (positions are GLOBAL sequence positions per token, -1 =
@@ -240,6 +293,13 @@ def build_paged_fns(
     sit at DIFFERENT indices under continuous batching).  Every array is
     fixed-width; inactive rows ride along with position -1 (their scatter
     drops, their sampled token is ignored host-side).
+
+    ``adapter_ids`` [B] int32 (-1 = base model) reaches the model only
+    when it was cloned with LoRA factors — non-LoRA builds trace it as an
+    unused input, so signatures (and compile counts) stay uniform across
+    modes.  ``quant=True`` makes ``decode_step`` expect the int8 tree
+    (ops/quant.quantize_tree) and dequantize in-graph; prefill and verify
+    keep the plain tree.
     """
     if block_size < 1:
         raise ValueError(f"block_size must be >= 1, got {block_size}")
@@ -251,44 +311,82 @@ def build_paged_fns(
         decode=True, paged=True,
         kv_block_size=int(block_size), kv_num_blocks=int(num_blocks),
     )
+    has_lora = getattr(paged_model, "lora_adapters", 0) > 0
+    pool_rows = int(num_blocks) * int(block_size)
     # no eos_id here: EOS detection is the HOST's job in paged mode — the
     # scheduler reads every token anyway (to stream it and retire slots),
     # so the programs stay pure token-samplers and the stop conditions
     # (eos / per-request max_new) live in one place
     sample = _make_sampler(temperature)
 
+    def _apply(params, pool, tokens, positions, block_tables, adapter_ids):
+        args = (tokens, positions, block_tables)
+        if has_lora:
+            args = args + (adapter_ids,)
+        return paged_model.apply(
+            {"params": params, "cache": pool}, *args, mutable=["cache"],
+        )
+
     @jax.jit
     def prefill(
         params, pool, tokens, positions, block_tables, last_col, row_keys,
-        gen_index,
+        gen_index, adapter_ids=None,
     ):
-        logits, variables = paged_model.apply(
-            {"params": params, "cache": pool},
-            tokens, positions, block_tables, mutable=["cache"],
+        logits, variables = _apply(
+            params, pool, tokens, positions, block_tables, adapter_ids
         )
         last = jnp.take_along_axis(logits, last_col[:, None, None], axis=1)[:, 0]
         tok = sample(last, _token_keys(row_keys, gen_index))
         return tok, jnp.isfinite(last).all(axis=-1), variables["cache"]
 
     @jax.jit
-    def decode_step(params, pool, prev_tok, pos, block_tables, row_keys, gen_index):
-        logits, variables = paged_model.apply(
-            {"params": params, "cache": pool},
-            prev_tok[:, None], pos[:, None], block_tables, mutable=["cache"],
+    def decode_step(
+        params, pool, prev_tok, pos, block_tables, row_keys, gen_index,
+        adapter_ids=None,
+    ):
+        if quant:
+            params = dequantize_tree(params, jnp.float32)
+        logits, variables = _apply(
+            params, pool, prev_tok[:, None], pos[:, None], block_tables,
+            adapter_ids,
         )
         tok = sample(logits[:, 0], _token_keys(row_keys, gen_index))
         return tok, jnp.isfinite(logits[:, 0]).all(axis=-1), variables["cache"]
 
+    @jax.jit
+    def verify(params, pool, tokens, positions, block_tables, adapter_ids=None):
+        logits, variables = _apply(
+            params, pool, tokens, positions, block_tables, adapter_ids
+        )
+        return logits.astype(jnp.float32), variables["cache"]
+
+    @jax.jit
+    def copy_rows(pool, src, dst):
+        src_c = jnp.clip(src, 0, pool_rows - 1)
+
+        def cp(leaf):
+            if (
+                hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] == pool_rows
+            ):
+                return leaf.at[dst].set(leaf[src_c], mode="drop")
+            return leaf
+
+        return jax.tree_util.tree_map(cp, pool)
+
     def init_pool(params):
         # any concrete shapes work — the pool's shape depends only on the
         # model config, and eval_shape never touches device memory
+        init_args = [
+            jnp.zeros((1, 1), jnp.int32),
+            jnp.zeros((1, 1), jnp.int32),
+            jnp.zeros((1, 1), jnp.int32),
+        ]
+        if has_lora:
+            init_args.append(jnp.zeros((1,), jnp.int32))
         shapes = jax.eval_shape(
             lambda p: paged_model.apply(
-                {"params": p},
-                jnp.zeros((1, 1), jnp.int32),
-                jnp.zeros((1, 1), jnp.int32),
-                jnp.zeros((1, 1), jnp.int32),
-                mutable=["cache"],
+                {"params": p}, *init_args, mutable=["cache"],
             )[1]["cache"],
             params,
         )
@@ -296,4 +394,4 @@ def build_paged_fns(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
 
-    return _PagedFns(prefill, decode_step, init_pool)
+    return _PagedFns(prefill, decode_step, init_pool, verify, copy_rows)

@@ -26,6 +26,7 @@ import numpy as np
 from ..engine.checkpoint import load_serving_state
 from ..engine.steps import _input_normalizer
 from ..models import get_model
+from ..ops.quant import quantize_tree
 from ..parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
@@ -34,8 +35,10 @@ from ..parallel.mesh import (
 )
 from .batcher import DynamicBatcher, Request
 from .decode import build_generate_fn
+from .lora import LoraRegistry
 from .metrics import ServingMetrics
 from .scheduler import ContinuousScheduler
+from .speculative import SpeculativeSpec
 
 __all__ = ["InferenceEngine"]
 
@@ -80,6 +83,9 @@ class InferenceEngine:
         seed: int = 0,
         scheduler: Optional[Dict[str, Any]] = None,
         resilience: Optional[Dict[str, Any]] = None,
+        quant: Optional[Dict[str, Any]] = None,
+        lora: Optional[Dict[str, Any]] = None,
+        speculative: Optional[Dict[str, Any]] = None,
         logger: Optional[logging.Logger] = None,
         replica_id: Optional[int] = None,
         heartbeat_path: Optional[str] = None,
@@ -101,6 +107,52 @@ class InferenceEngine:
         n_data = mesh.shape[DATA_AXIS]
         self.batch_buckets = sorted({_round_up(b, n_data) for b in batch_buckets})
         self.seq_buckets = sorted(set(int(s) for s in seq_buckets))
+        # stacked decode-path modes (serving.quant / serving.lora /
+        # serving.speculative), each parsed with the scheduler block's
+        # copy-pop-raise idiom so a typo'd key fails at build time
+        quant_cfg = dict(quant or {})
+        use_quant = bool(quant_cfg.pop("enabled", False))
+        if quant_cfg:
+            raise ValueError(f"unknown serving.quant keys: {sorted(quant_cfg)}")
+        lora_cfg = dict(lora or {})
+        use_lora = bool(lora_cfg.pop("enabled", False))
+        lora_rank = int(lora_cfg.pop("rank", 8))
+        lora_adapters = lora_cfg.pop("adapters", None)
+        if lora_cfg:
+            raise ValueError(f"unknown serving.lora keys: {sorted(lora_cfg)}")
+        spec_cfg = dict(speculative or {})
+        use_spec = bool(spec_cfg.pop("enabled", False))
+        spec_k = int(spec_cfg.pop("k", 4))
+        spec_draft = spec_cfg.pop("draft", None)
+        spec_draft_seed = int(spec_cfg.pop("draft_seed", 0))
+        if spec_cfg:
+            raise ValueError(
+                f"unknown serving.speculative keys: {sorted(spec_cfg)}"
+            )
+        use_sched = is_lm and bool((scheduler or {}).get("enabled", False))
+        if (use_quant or use_lora or use_spec) and not is_lm:
+            raise ValueError("serving.quant/lora/speculative are LM-only")
+        if (use_lora or use_spec) and not use_sched:
+            raise ValueError(
+                "serving.lora and serving.speculative require "
+                "serving.scheduler.enabled — adapter multiplexing and "
+                "draft verification live in the continuous scheduler's "
+                "paged decode programs"
+            )
+        base_model = model
+        # surfaced for logs/bench: which decode-path modes are on
+        self.serving_modes = {
+            "quant": use_quant, "lora": use_lora, "speculative": use_spec,
+        }
+        self.lora_registry: Optional[LoraRegistry] = None
+        if use_lora:
+            self.lora_registry = LoraRegistry(lora_rank, lora_adapters)
+            model, params = self.lora_registry.graft(model, params)
+            self.model = model
+            self.logger.info(
+                "multi-LoRA serving: rank %d, adapters %s",
+                self.lora_registry.rank, self.lora_registry.names,
+            )
         if is_lm:
             if not self.seq_buckets:
                 raise ValueError("LM serving needs at least one seq bucket")
@@ -112,7 +164,8 @@ class InferenceEngine:
                     f"model max_len {model.max_len}"
                 )
             self._generate = build_generate_fn(
-                model, max_new_tokens, temperature=temperature, eos_id=eos_id
+                model, max_new_tokens, temperature=temperature, eos_id=eos_id,
+                quant=use_quant,
             )
         else:
             normalize = _input_normalizer(input_norm)
@@ -133,6 +186,14 @@ class InferenceEngine:
         self.batch_stats = (
             jax.device_put(batch_stats, rep) if batch_stats else {}
         )
+        # int8 decode (serving.quant) on the BATCHER path: quantize once
+        # at build and hand the int8 tree to the decode phase only; the
+        # scheduler path quantizes its own copy (serving/scheduler.py)
+        self._decode_params = None
+        if use_quant and is_lm and not use_sched:
+            self._decode_params = jax.device_put(
+                quantize_tree(self.params), rep
+            )
         self._rng = jax.random.PRNGKey(seed)
         self._batch_counter = 0
         # continuous batching (serving.scheduler.enabled): the LM decode
@@ -144,6 +205,25 @@ class InferenceEngine:
         self.scheduler: Optional[ContinuousScheduler] = None
         self.batcher: Optional[DynamicBatcher] = None
         if use_sched:
+            spec = None
+            if use_spec:
+                if spec_draft is not None:
+                    # the draft clones the BASE model (never the LoRA
+                    # graft: a draft miss only costs acceptance) with the
+                    # config's field overrides, random-init like the
+                    # checkpoint-less smoke mode — restoring a trained
+                    # draft checkpoint is ROADMAP work
+                    draft_model = base_model.clone(**dict(spec_draft))
+                    draft_params = jax.device_put(
+                        draft_model.init(
+                            jax.random.PRNGKey(spec_draft_seed),
+                            jnp.zeros((1, 1), jnp.int32),
+                        )["params"],
+                        rep,
+                    )
+                    spec = SpeculativeSpec(spec_k, draft_model, draft_params)
+                else:
+                    spec = SpeculativeSpec(spec_k)
             self.scheduler = ContinuousScheduler(
                 model, self.params,
                 slots=int(sched_cfg.pop("slots", 8)),
@@ -161,6 +241,9 @@ class InferenceEngine:
                 seed=seed,
                 pool_sharding=rep,
                 resilience=resilience,
+                quant=use_quant,
+                lora=self.lora_registry,
+                speculative=spec,
                 logger=self.logger,
                 replica_id=replica_id,
                 heartbeat_path=heartbeat_path,
@@ -272,6 +355,9 @@ class InferenceEngine:
             seed=int(serve.get("seed", 0)),
             scheduler=serve.get("scheduler"),
             resilience=serve.get("resilience"),
+            quant=serve.get("quant"),
+            lora=serve.get("lora"),
+            speculative=serve.get("speculative"),
             logger=logger,
         )
         return model, params, batch_stats, mesh, kwargs
@@ -286,6 +372,7 @@ class InferenceEngine:
         on_token=None,
         rng=None,
         replay_tokens=None,
+        adapter: Optional[str] = None,
     ):
         """Validate + enqueue one request; returns its result future.
 
@@ -296,7 +383,9 @@ class InferenceEngine:
         batcher path the result is truncated host-side — the batch still
         pays the full decode; the scheduler path retires the slot the
         moment the cap is hit), ``on_token``/``rng`` stream tokens /
-        override the sampling key and need the continuous scheduler.
+        override the sampling key and need the continuous scheduler, and
+        ``adapter`` routes the request through a registered LoRA adapter
+        (``serving.lora``, scheduler path only).
         """
         if self.is_lm:
             prompt = np.asarray(payload, np.int32)
@@ -321,13 +410,17 @@ class InferenceEngine:
                 return self.scheduler.submit(
                     prompt, deadline_ms=deadline_ms,
                     max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
-                    replay_tokens=replay_tokens,
+                    replay_tokens=replay_tokens, adapter=adapter,
                 )
-            if on_token is not None or rng is not None or replay_tokens:
+            if (
+                on_token is not None or rng is not None or replay_tokens
+                or adapter is not None
+            ):
                 raise ValueError(
-                    "on_token / per-request rng / replay_tokens require "
-                    "serving.scheduler.enabled (the batcher path samples "
-                    "whole batches and resolves futures only at the end)"
+                    "on_token / per-request rng / replay_tokens / adapter "
+                    "require serving.scheduler.enabled (the batcher path "
+                    "samples whole batches and resolves futures only at "
+                    "the end)"
                 )
             return self.batcher.submit(
                 prompt, deadline_ms=deadline_ms,
@@ -335,10 +428,11 @@ class InferenceEngine:
             )
         if (
             max_new_tokens is not None or on_token is not None
-            or rng is not None or replay_tokens
+            or rng is not None or replay_tokens or adapter is not None
         ):
             raise ValueError(
-                "max_new_tokens/on_token/rng/replay_tokens are LM-only"
+                "max_new_tokens/on_token/rng/replay_tokens/adapter are "
+                "LM-only"
             )
         img = np.asarray(payload)
         want = (self.image_size, self.image_size, 3)
@@ -473,7 +567,11 @@ class InferenceEngine:
         )
         jax.block_until_ready(carry)
         t1 = time.perf_counter()
-        out, gen_len = self._generate.decode(self.params, plen_dev, carry)
+        out, gen_len = self._generate.decode(
+            self.params if self._decode_params is None
+            else self._decode_params,
+            plen_dev, carry,
+        )
         out = np.asarray(out)  # host materialization = decode sync
         gen_len = np.asarray(gen_len)
         t2 = time.perf_counter()

@@ -130,23 +130,32 @@ class PagedKVPool:
 
     # ------------------------------------------------------------------ #
 
-    def _chain_keys(self, prompt: Sequence[int]):
+    def _chain_keys(self, prompt: Sequence[int], namespace=None):
         """(key, block_index) for each reusable FULL prompt block: the key
         chains the exact token contents of every block up to this one, so
         equal keys imply bitwise-equal cached K/V.  Capped below the last
-        prompt token — its logits must always be recomputed."""
+        prompt token — its logits must always be recomputed.
+
+        ``namespace`` seeds the chain: two requests share cached blocks
+        only when BOTH their namespace and their token prefix match.  The
+        multi-LoRA scheduler passes the adapter id here — identical
+        prompts under different adapters produce different K/V (the
+        adapter delta feeds the qkv projection), so cross-tenant reuse
+        would be silent corruption, not a cache hit."""
         bs = self.block_size
-        key: tuple = ()
+        key: tuple = (namespace,)
         for i in range((len(prompt) - 1) // bs):
             key = (key, tuple(int(t) for t in prompt[i * bs : (i + 1) * bs]))
             yield key, i
 
-    def lookup_prefix(self, prompt: Sequence[int]) -> List[int]:
+    def lookup_prefix(
+        self, prompt: Sequence[int], namespace=None
+    ) -> List[int]:
         """Longest cached chain of full prompt blocks (no refs taken)."""
         if not self.prefix_cache:
             return []
         out: List[int] = []
-        for key, _ in self._chain_keys(prompt):
+        for key, _ in self._chain_keys(prompt, namespace):
             blk = self._cache.get(key)
             if blk is None:
                 break
@@ -155,7 +164,11 @@ class PagedKVPool:
         return out
 
     def admit(
-        self, prompt: Sequence[int], max_new: int
+        self,
+        prompt: Sequence[int],
+        max_new: int,
+        namespace=None,
+        extra_blocks: int = 0,
     ) -> Optional[Admission]:
         """Reserve the request's full footprint; ``None`` = wait.
 
@@ -163,15 +176,21 @@ class PagedKVPool:
         remaining blocks come from the free list, evicting LRU prefix-cache
         entries if that is what it takes.  A request whose footprint
         exceeds the whole pool raises — waiting would never help.
+
+        ``extra_blocks`` private scratch blocks are appended after the
+        footprint (the speculative fork's spare block rides here so its
+        lifetime and refcount accounting are the admission's own).
         """
-        total = self.blocks_needed(len(prompt), max_new)
+        if extra_blocks < 0:
+            raise ValueError(f"extra_blocks must be >= 0, got {extra_blocks}")
+        total = self.blocks_needed(len(prompt), max_new) + extra_blocks
         if total > self.num_blocks:
             raise ValueError(
                 f"request needs {total} blocks but the pool only has "
                 f"{self.num_blocks} (prompt {len(prompt)} + max_new "
                 f"{max_new} @ block_size {self.block_size})"
             )
-        shared = self.lookup_prefix(prompt)
+        shared = self.lookup_prefix(prompt, namespace)
         fresh = self._alloc_with_evict(total - len(shared))
         if fresh is None:
             return None
@@ -182,14 +201,14 @@ class PagedKVPool:
         return Admission(shared + fresh, len(shared), self.block_size)
 
     def register_prefix(
-        self, prompt: Sequence[int], admission: Admission
+        self, prompt: Sequence[int], admission: Admission, namespace=None
     ) -> None:
         """Publish this prefill's full prompt blocks for future reuse.
         First-writer-wins: a chain link another request already registered
         keeps its block (ours stays private and is freed at release)."""
         if not self.prefix_cache:
             return
-        for key, i in self._chain_keys(prompt):
+        for key, i in self._chain_keys(prompt, namespace):
             if key in self._cache:
                 continue
             blk = admission.block_ids[i]

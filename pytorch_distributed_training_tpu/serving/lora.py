@@ -1,0 +1,188 @@
+"""Multi-LoRA adapter registry: many tenants, one base model, one batch.
+
+Per-tenant finetunes that share a base model differ only by low-rank
+deltas (LoRA, Hu et al. 2021), so serving N of them does NOT need N
+engines: the registry stacks every adapter's factors into the params
+tree (``[N, din, r]`` / ``[N, r, dout]`` leaves next to each Dense
+kernel, ops/attention.py) and the paged programs select each batch row's
+adapter by id at runtime (ops/lora.py gather-einsum) — requests of
+different tenants decode in the SAME iteration-level batch, which is the
+whole multiplexing win: one pool, one program set, one compile count.
+
+Adapters here are SYNTHESIZED deterministically from their config seed
+(``jax.random.normal * 0.02`` for both factors, keyed per leaf) — the
+smoke/bench analog of the engine's random-init serving mode; restoring
+real adapter checkpoints over the same stacked leaves is the follow-up
+(ROADMAP).  Synthesized factors are deliberately NONZERO on both sides
+so the multi-LoRA parity oracle tests a real delta, not a no-op.
+
+:meth:`merged_params` is the oracle's other half: fold adapter ``k``
+into the base kernels (``W + A_k B_k``) to get a PLAIN params tree a
+base engine can serve — the multiplexed engine's per-adapter token
+stream must match that single-tenant engine token for token
+(tests/test_serving.py).
+"""
+from __future__ import annotations
+
+import zlib
+from collections.abc import Mapping
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LoraRegistry"]
+
+_LORA_SUFFIXES = ("_lora_a", "_lora_b")
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(p, "key", getattr(p, "name", p))) for p in path
+    )
+
+
+class LoraRegistry:
+    """Fixed adapter set (name -> id) + params-tree grafting.
+
+    ``adapters`` entries are dicts ``{name, seed?}`` (or bare name
+    strings); the set is FROZEN at engine build — ``lora_adapters`` is a
+    static model field, so adding an adapter means rebuilding the
+    programs, exactly like changing a bucket grid.
+    """
+
+    def __init__(self, rank: int, adapters):
+        if int(rank) < 1:
+            raise ValueError(f"serving.lora.rank must be >= 1, got {rank}")
+        entries = list(adapters or [])
+        if not entries:
+            raise ValueError(
+                "serving.lora.adapters must list at least one adapter"
+            )
+        self.rank = int(rank)
+        self.names: List[str] = []
+        self.seeds: List[int] = []
+        for i, ent in enumerate(entries):
+            if isinstance(ent, str):
+                name, seed = ent, i
+            else:
+                e = dict(ent)
+                name = e.pop("name", None)
+                if name is None:
+                    raise ValueError(
+                        f"serving.lora.adapters[{i}] needs a name"
+                    )
+                seed = int(e.pop("seed", i))
+                if e:
+                    raise ValueError(
+                        f"unknown serving.lora.adapters keys for {name!r}: "
+                        f"{sorted(e)}"
+                    )
+            name = str(name)
+            if name in self.names:
+                raise ValueError(f"duplicate adapter name {name!r}")
+            self.names.append(name)
+            self.seeds.append(seed)
+        self._ids = {n: i for i, n in enumerate(self.names)}
+
+    def __len__(self) -> int:
+        return len(self.names)
+
+    def id_of(self, name: str) -> int:
+        """Adapter id (the row index of its stacked factors)."""
+        if name not in self._ids:
+            raise ValueError(
+                f"unknown adapter {name!r}; registered: {self.names}"
+            )
+        return self._ids[name]
+
+    # ------------------------------------------------------------------ #
+
+    def graft(self, model, params):
+        """``(lora_model, lora_params)``: the base model cloned with this
+        registry's static LoRA fields, and the base params tree with the
+        stacked factor leaves added (every base leaf passes through by
+        reference — grafting never copies the base weights).
+
+        The target structure comes from ``jax.eval_shape`` over the LoRA
+        model's init (correct flax paths, no device compute); factor
+        leaves are then synthesized per adapter seed, everything else is
+        looked up in ``params`` by path.
+        """
+        lora_model = model.clone(
+            lora_rank=self.rank, lora_adapters=len(self)
+        )
+        shapes = jax.eval_shape(
+            lora_model.init,
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 1), jnp.int32),
+        )["params"]
+        flat = {}
+        jax.tree_util.tree_map_with_path(
+            lambda p, leaf: flat.__setitem__(_path_str(p), leaf), params
+        )
+
+        def fill(path, shape_leaf):
+            ps = _path_str(path)
+            if ps.rsplit("/", 1)[-1].endswith(_LORA_SUFFIXES):
+                return self._factor(ps, shape_leaf)
+            base = flat.get(ps)
+            if base is None or tuple(base.shape) != tuple(shape_leaf.shape):
+                raise ValueError(
+                    f"LoRA graft: base params have no leaf {ps!r} of shape "
+                    f"{tuple(shape_leaf.shape)}"
+                )
+            return base
+
+        lora_params = jax.tree_util.tree_map_with_path(fill, shapes)
+        return lora_model, lora_params
+
+    def _factor(self, path_str: str, shape_leaf):
+        """One stacked ``[N, ...]`` factor leaf: row ``k`` is adapter
+        ``k``'s factor, keyed by (adapter seed, leaf path) so every leaf
+        of every adapter is an independent deterministic draw."""
+        tag = zlib.crc32(path_str.encode()) & 0x7FFFFFFF
+        rows = [
+            jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(seed), tag),
+                shape_leaf.shape[1:],
+                jnp.float32,
+            )
+            * 0.02
+            for seed in self.seeds
+        ]
+        return jnp.stack(rows).astype(shape_leaf.dtype)
+
+    # ------------------------------------------------------------------ #
+
+    def merged_params(self, lora_params, name: str):
+        """Fold adapter ``name`` into the base kernels: a PLAIN params
+        tree (no factor leaves) with ``kernel += A_k @ B_k`` wherever the
+        grafted tree carries factors — structurally identical to the base
+        params, so a base (non-LoRA) engine serves it directly.  The
+        multi-LoRA parity oracle's reference construction."""
+        k = self.id_of(name)
+
+        def visit(node):
+            if not isinstance(node, Mapping):
+                return node
+            out = {
+                key: visit(val)
+                for key, val in node.items()
+                if not key.endswith(_LORA_SUFFIXES)
+            }
+            for key in node:
+                if not key.endswith("_lora_a"):
+                    continue
+                stem = key[: -len("_lora_a")]
+                a = jnp.asarray(node[key])[k].astype(jnp.float32)
+                b = jnp.asarray(node[stem + "_lora_b"])[k].astype(jnp.float32)
+                kern = out[stem]["kernel"]
+                sub = dict(out[stem])
+                sub["kernel"] = (kern.astype(jnp.float32) + a @ b).astype(
+                    kern.dtype
+                )
+                out[stem] = sub
+            return out
+
+        return visit(lora_params)

@@ -68,6 +68,31 @@ class ServingMetrics:
         self._decode_tokens = 0  # guarded by: self._lock
         self._prefill_s = 0.0  # guarded by: self._lock
         self._decode_s = 0.0  # guarded by: self._lock
+        # multi-tenant (serving.lora): per-adapter latency/len histograms,
+        # lazily created in THIS private registry under adapter_<name>_*
+        # — the same namespacing move replica_id makes in the process
+        # registry, one level down.  Base-model requests stay in the flat
+        # instruments only.
+        self._adapter_hists: Dict[str, tuple] = {}  # guarded by: self._lock
+
+    def adapter_name(self, adapter: str, name: str) -> str:
+        """Registry name for adapter-scoped instrument ``name``."""
+        return f"adapter_{adapter}_{name}"
+
+    def _adapter_instruments(self, adapter: str):
+        with self._lock:
+            pair = self._adapter_hists.get(adapter)
+            if pair is None:
+                pair = (
+                    self._registry.histogram(
+                        self.adapter_name(adapter, "latency_ms"), _RESERVOIR
+                    ),
+                    self._registry.histogram(
+                        self.adapter_name(adapter, "gen_len"), _RESERVOIR
+                    ),
+                )
+                self._adapter_hists[adapter] = pair
+            return pair
 
     def incr(self, name: str, n: int = 1) -> None:
         """Bump a named degradation counter (e.g. ``timeouts``, ``sheds``)."""
@@ -130,11 +155,25 @@ class ServingMetrics:
     # scheduler has no "batch" — requests retire one by one and device
     # time accrues per prefill call / per decode step
 
-    def record_request(self, enqueued_at: float, gen_len: int) -> None:
-        """One RETIRED request: end-to-end latency + generated length."""
+    def record_request(
+        self, enqueued_at: float, gen_len: int,
+        adapter: Optional[str] = None,
+    ) -> None:
+        """One RETIRED request: end-to-end latency + generated length.
+
+        ``adapter`` (the request's LoRA adapter name) additionally lands
+        the observation in that tenant's own instruments, so one snapshot
+        answers per-tenant latency questions without a second ledger."""
         now = time.monotonic()
         self._latency_ms.observe((now - enqueued_at) * 1000.0)
         self._gen_len.observe(int(gen_len))
+        if adapter is not None:
+            lat_h, gen_h = self._adapter_instruments(adapter)
+            lat_h.observe((now - enqueued_at) * 1000.0)
+            gen_h.observe(int(gen_len))
+            self._registry.counter(
+                self.adapter_name(adapter, "requests")
+            ).inc()
         with self._lock:
             self._items += int(gen_len)
             if self._first_t is None:
@@ -245,6 +284,27 @@ class ServingMetrics:
         misses = counters.get("prefix_miss_blocks", 0)
         if hits + misses:
             out["prefix_hit_rate"] = float(hits / (hits + misses))
+        # speculative decode: fraction of draft proposals the target kept
+        # (the bonus token is free and not counted on either side)
+        proposed = counters.get("spec_proposed", 0)
+        if proposed:
+            out["spec_acceptance_rate"] = float(
+                counters.get("spec_accepted", 0) / proposed
+            )
+        # per-adapter (multi-LoRA) views: same shape as the flat latency
+        # fields, one set per tenant that retired at least one request
+        with self._lock:
+            adapter_hists = dict(self._adapter_hists)
+        for name, (lat_h, gen_h) in sorted(adapter_hists.items()):
+            a_lat = lat_h.snapshot()
+            a_gen = gen_h.snapshot()
+            if a_lat["count"]:
+                pre = self.adapter_name(name, "latency_ms")
+                out[f"{pre}_p50"] = float(a_lat["p50"])
+                out[f"{pre}_p99"] = float(a_lat["p99"])
+                out[f"{pre}_mean"] = float(a_lat["mean"])
+            if a_gen["count"]:
+                out[self.adapter_name(name, "gen_tokens")] = int(a_gen["sum"])
         # health gauges ride along once record_health has run (absent
         # otherwise, keeping pre-resilience snapshots byte-stable)
         gauges = self._registry.snapshot()["gauges"]

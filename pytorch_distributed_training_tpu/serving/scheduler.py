@@ -68,11 +68,13 @@ from ..engine import fault
 from ..engine.watchdog import StepWatchdog
 from ..telemetry.registry import get_registry
 from ..telemetry.spans import span
+from ..ops.quant import quantize_tree
 from .batcher import OverloadedError
 from .decode import build_paged_fns
 from .kv_pool import PagedKVPool
 from .metrics import ServingMetrics
 from .resilience import HungTickError, PoisonedRequestError, ServingSupervisor
+from .speculative import greedy_accept
 
 __all__ = ["ContinuousScheduler"]
 
@@ -83,6 +85,7 @@ class _PagedRequest:
     __slots__ = (
         "prompt", "max_new", "future", "enqueued_at", "deadline",
         "on_token", "row_key", "admission", "slot", "tokens", "poison",
+        "adapter", "adapter_name", "draft_admission",
     )
 
     def __init__(self, prompt, max_new, deadline, on_token, row_key):
@@ -97,6 +100,9 @@ class _PagedRequest:
         self.slot = -1
         self.tokens: List[int] = []
         self.poison = None  # fault-injection marker ("raise")
+        self.adapter = -1  # LoRA adapter id; -1 = base model
+        self.adapter_name: Optional[str] = None
+        self.draft_admission = None  # speculative mode: draft-pool blocks
 
     @property
     def gen_idx(self) -> int:
@@ -133,6 +139,9 @@ class ContinuousScheduler:
         seed: int = 0,
         pool_sharding=None,
         resilience: Optional[Dict[str, Any]] = None,
+        quant: bool = False,
+        lora=None,
+        speculative=None,
         logger: Optional[logging.Logger] = None,
         start: bool = True,
         replica_id: Optional[int] = None,
@@ -200,22 +209,55 @@ class ContinuousScheduler:
         self._prefix_cache = bool(prefix_cache)
         self._pool_sharding = pool_sharding
 
+        # multi-tenant decode modes (PR 17), each default-off:
+        #   quant — decode programs take the int8 tree (ops/quant.py);
+        #   lora — LoraRegistry: per-row adapter selection over a model
+        #     already cloned/grafted with stacked factors (engine's job);
+        #   speculative — SpeculativeSpec: draft-proposed, target-verified
+        #     rounds over a SECOND paged pool for the draft.
+        self._quant = bool(quant)
+        self._lora = lora
+        self._spec = speculative
+        self._has_lora = getattr(model, "lora_adapters", 0) > 0
+        if self._lora is not None and not self._has_lora:
+            raise ValueError(
+                "a LoRA registry was given but the model has no stacked "
+                "factors — pass the registry's grafted (model, params) pair"
+            )
+        if self._spec is not None and self._temperature != 0.0:
+            raise ValueError(
+                "speculative decoding requires temperature 0.0: the greedy "
+                "accept rule is exact only against the argmax stream (the "
+                "sampled accept rule is serving/speculative.py's "
+                "sampled_accept, not yet wired to the scheduler)"
+            )
+        # speculative branch forking reserves ONE private spare block per
+        # request on top of its footprint (the CoW target for the
+        # boundary block each round)
+        self._extra_blocks = 1 if self._spec is not None else 0
+
         self._kv = PagedKVPool(num_blocks, block_size, prefix_cache)
         # every block table is padded to the worst-case footprint so the
         # decode program's shape never depends on a request's length
         self.table_blocks = self._kv.blocks_needed(
             self.seq_buckets[-1], self.max_new_tokens
         )
-        if self.table_blocks > self._kv.num_blocks:
+        if self.table_blocks + self._extra_blocks > self._kv.num_blocks:
             raise ValueError(
-                f"worst-case request needs {self.table_blocks} blocks but "
+                f"worst-case request needs "
+                f"{self.table_blocks + self._extra_blocks} blocks but "
                 f"num_blocks is {self._kv.num_blocks}; grow the pool or "
                 "shrink seq_buckets/max_new_tokens"
             )
         self._fns = build_paged_fns(
-            model, block_size, num_blocks, temperature=temperature
+            model, block_size, num_blocks, temperature=temperature,
+            quant=self._quant,
         )
         self.params = params
+        # decode programs stream the int8 tree in quant mode; prefill and
+        # verify always take the plain tree (compute-bound / accuracy
+        # anchor respectively — see ops/quant.py)
+        self._qparams = quantize_tree(params) if self._quant else None
         self._pool = self._fns.init_pool(params)
         if pool_sharding is not None:
             # land the initial pool under the same sharding jit will give
@@ -223,6 +265,24 @@ class ContinuousScheduler:
             # recompiles for the sharding change (engine passes the mesh's
             # replicated sharding; plain single-device use needs nothing)
             self._pool = jax.device_put(self._pool, pool_sharding)
+        self._draft_fns = None
+        self._draft_pool = None
+        self._dkv: Optional[PagedKVPool] = None
+        if self._spec is not None:
+            # self-draft (no dedicated draft model) = draft IS the target:
+            # acceptance pins at 1.0, the end-to-end exactness test
+            self._draft_model = (
+                self._spec.draft_model
+                if self._spec.draft_model is not None else model
+            )
+            self._draft_params = (
+                self._spec.draft_params
+                if self._spec.draft_params is not None else params
+            )
+            self._draft_lora = (
+                getattr(self._draft_model, "lora_adapters", 0) > 0
+            )
+            self._build_draft()
         self._pad_key = jax.random.PRNGKey(0)
         self._base_rng = jax.random.PRNGKey(int(seed))
         self._seq_no = 0  # guarded by: self._cond
@@ -314,8 +374,14 @@ class ContinuousScheduler:
         on_token: Optional[Callable[[int], None]] = None,
         rng=None,
         replay_tokens: Optional[Sequence[int]] = None,
+        adapter: Optional[str] = None,
     ) -> Future:
         """Enqueue one prompt; the future resolves at retirement.
+
+        ``adapter`` names a registered LoRA adapter (serving.lora): this
+        request decodes through that adapter's low-rank delta, batched in
+        the SAME iteration as every other tenant's rows; None = the base
+        model.  Requires the engine's LoRA registry.
 
         ``max_new_tokens`` caps THIS request below the scheduler-wide
         budget (its slot retires early instead of padding the batch with
@@ -352,6 +418,14 @@ class ContinuousScheduler:
         dl = deadline_ms if deadline_ms is not None else self.deadline_ms
         if dl is not None and dl <= 0:
             raise ValueError(f"deadline_ms must be > 0, got {dl}")
+        aid = -1
+        if adapter is not None:
+            if self._lora is None:
+                raise ValueError(
+                    "adapter= requires serving.lora.enabled (no adapter "
+                    "registry on this engine)"
+                )
+            aid = self._lora.id_of(adapter)
         replay = [int(t) for t in replay_tokens] if replay_tokens else []
         if replay:
             if rng is None:
@@ -394,6 +468,8 @@ class ContinuousScheduler:
                 deadline=(time.monotonic() + dl / 1000.0) if dl else None,
                 on_token=on_token, row_key=rng,
             )
+            req.adapter = aid
+            req.adapter_name = adapter
             if replay:
                 req.tokens = replay
             self._queue.append(req)
@@ -418,9 +494,12 @@ class ContinuousScheduler:
 
     def compile_count(self) -> int:
         """Distinct XLA programs compiled so far: bounded by the prefill
-        bucket grid + the single decode-step program, whatever traffic
-        does."""
-        return self._fns._cache_size()
+        bucket grid + ONE program each for decode/verify/copy (per model —
+        the speculative draft has its own set), whatever traffic does."""
+        n = self._fns._cache_size()
+        if self._draft_fns is not None:
+            n += self._draft_fns._cache_size()
+        return n
 
     def drain(self, deadline_ms: Optional[float] = None) -> float:
         """Graceful shutdown: stop admitting NEW submissions, finish the
@@ -663,7 +742,10 @@ class ContinuousScheduler:
         n_active = self.active()
         if n_active:
             self._tick_phase = "decode"
-            self._decode_step()
+            if self._spec is not None:
+                self._spec_decode_step()
+            else:
+                self._decode_step()
         self._publish_pool_gauges()
         return bool(newly) or n_active > 0
 
@@ -751,10 +833,27 @@ class ContinuousScheduler:
             max_admit = min(len(free), self.batch_buckets[-1])
             while self._queue and len(newly) < max_admit:
                 req = self._queue[0]
-                adm = self._kv.admit(req.prompt.tolist(), req.max_new)
+                # the adapter id namespaces the prefix cache: identical
+                # prompts under different adapters have DIFFERENT K/V
+                # (cross-tenant reuse would be silent corruption)
+                adm = self._kv.admit(
+                    req.prompt.tolist(), req.max_new,
+                    namespace=req.adapter,
+                    extra_blocks=self._extra_blocks,
+                )
                 if adm is None:
                     self._bump("admission_waits")
                     break
+                if self._spec is not None:
+                    # all-or-nothing across BOTH pools: holding the target
+                    # reservation while waiting on the draft pool could
+                    # deadlock two half-admitted requests
+                    dadm = self._dkv.admit(req.prompt.tolist(), req.max_new)
+                    if dadm is None:
+                        self._kv.release(adm)
+                        self._bump("admission_waits")
+                        break
+                    req.draft_admission = dadm
                 self._queue.popleft()
                 req.admission = adm
                 req.slot = free[len(newly)]
@@ -776,6 +875,18 @@ class ContinuousScheduler:
                 return b
         raise ValueError(f"{kind} {n} exceeds largest bucket {buckets[-1]}")
 
+    def _table_ids(self, req: _PagedRequest) -> List[int]:
+        """The request's LOGICAL block table: the admission's footprint
+        blocks in order.  In speculative mode the admission carries one
+        extra trailing block — the private spare — which is never in the
+        table; the verify step reaches it through the branch table and
+        commit swaps it in (swapping entries inside ``block_ids`` keeps
+        release/refcount accounting exact)."""
+        ids = req.admission.block_ids
+        if self._extra_blocks:
+            return ids[: len(ids) - self._extra_blocks]
+        return ids
+
     def _prefill(self, newly: List[_PagedRequest]) -> None:
         """Prefill every request admitted this tick.
 
@@ -789,6 +900,13 @@ class ContinuousScheduler:
             self._prefill_fresh(fresh)
         if replay:
             self._replay(replay)
+        if self._spec is not None:
+            # the draft pool needs the prompt K/V too (its own programs,
+            # its own blocks); requests evicted by the target prefill's
+            # output guard have already released both reservations
+            live = [r for r in newly if r.admission is not None]
+            if live:
+                self._draft_prefill(live)
 
     def _prefill_fresh(self, newly: List[_PagedRequest]) -> None:
         """One bucketed prefill over the fresh admissions of this tick.
@@ -805,17 +923,20 @@ class ContinuousScheduler:
         positions = np.full((bb, sb), -1, np.int32)
         tables = np.zeros((bb, self.table_blocks), np.int32)
         last_col = np.zeros((bb,), np.int32)
+        aids = np.full((bb,), -1, np.int32)
         keys = [self._pad_key] * bb
         for i, req in enumerate(newly):
             cl = req.admission.cached_len
             tokens[i, : suffix[i]] = req.prompt[cl:]
             positions[i, : suffix[i]] = np.arange(cl, req.prompt.size)
-            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            ids = self._table_ids(req)
+            tables[i, : len(ids)] = ids
             last_col[i] = suffix[i] - 1
+            aids[i] = req.adapter
             keys[i] = req.row_key
         tok, finite, self._pool = self._fns.prefill(
             self.params, self._pool, tokens, positions, tables,
-            last_col, jnp.stack(keys), np.zeros((bb,), np.int32),
+            last_col, jnp.stack(keys), np.zeros((bb,), np.int32), aids,
         )
         tok = np.asarray(tok)
         finite = np.asarray(finite)
@@ -830,11 +951,49 @@ class ContinuousScheduler:
                 continue
             # blocks are filled now — publish them for future prefix hits
             # BEFORE this request can retire and release them
-            self._kv.register_prefix(req.prompt.tolist(), req.admission)
+            self._kv.register_prefix(
+                req.prompt.tolist(), req.admission, namespace=req.adapter
+            )
             self._push_token(req, int(tok[i]))
         self.metrics.record_prefill(
             prompt_tokens=int(sum(suffix)), n_requests=len(newly),
             prefill_s=t1 - t0,
+        )
+
+    def _draft_prefill(self, reqs: List[_PagedRequest]) -> None:
+        """Scatter each admitted request's FULL prompt K/V into the draft
+        pool (speculative mode).  Always the whole prompt — the draft pool
+        runs without a prefix cache, so the target's cache hits cannot
+        shorten this call.  The sampled token is discarded (draft rounds
+        start from the COMMITTED stream) and the keys are the pad key:
+        the draft is always greedy.
+
+        Replayed (hot-restart) requests get the same treatment: their
+        generated tokens' draft K/V is NOT rebuilt — those rows read as
+        zeros, which can only depress the acceptance rate, never change
+        the committed stream (every emitted token is the target's).
+        """
+        bb = self._bucket_for(len(reqs), self.batch_buckets, "draft rows")
+        sb = self._bucket_for(
+            max(r.prompt.size for r in reqs), self.seq_buckets, "draft prompt"
+        )
+        tokens = np.zeros((bb, sb), np.int32)
+        positions = np.full((bb, sb), -1, np.int32)
+        tables = np.zeros((bb, self.table_blocks), np.int32)
+        last_col = np.zeros((bb,), np.int32)
+        aids = np.full((bb,), -1, np.int32)
+        for i, req in enumerate(reqs):
+            n = req.prompt.size
+            tokens[i, :n] = req.prompt
+            positions[i, :n] = np.arange(n)
+            dids = req.draft_admission.block_ids
+            tables[i, : len(dids)] = dids
+            last_col[i] = n - 1
+            aids[i] = req.adapter if self._draft_lora else -1
+        keys = jnp.stack([self._pad_key] * bb)
+        _tok, _finite, self._draft_pool = self._draft_fns.prefill(
+            self._draft_params, self._draft_pool, tokens, positions, tables,
+            last_col, keys, np.zeros((bb,), np.int32), aids,
         )
 
     def _replay(self, reqs: List[_PagedRequest]) -> None:
@@ -855,17 +1014,20 @@ class ContinuousScheduler:
         positions = np.full((bb, sb), -1, np.int32)
         tables = np.zeros((bb, self.table_blocks), np.int32)
         last_col = np.zeros((bb,), np.int32)
+        aids = np.full((bb,), -1, np.int32)
         keys = [self._pad_key] * bb
         for i, req in enumerate(reqs):
             cl = req.admission.cached_len
             tokens[i, : suffix[i]] = req.prompt[cl:]
             positions[i, : suffix[i]] = np.arange(cl, req.prompt.size)
-            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            ids = self._table_ids(req)
+            tables[i, : len(ids)] = ids
             last_col[i] = suffix[i] - 1
+            aids[i] = req.adapter
             keys[i] = req.row_key
         tok, finite, self._pool = self._fns.prefill(
             self.params, self._pool, tokens, positions, tables,
-            last_col, jnp.stack(keys), np.zeros((bb,), np.int32),
+            last_col, jnp.stack(keys), np.zeros((bb,), np.int32), aids,
         )
         tok = np.asarray(tok)
         finite = np.asarray(finite)
@@ -876,7 +1038,9 @@ class ContinuousScheduler:
                     req, cause=None, trigger="non-finite replay prefill logits"
                 )
                 continue
-            self._kv.register_prefix(req.prompt.tolist(), req.admission)
+            self._kv.register_prefix(
+                req.prompt.tolist(), req.admission, namespace=req.adapter
+            )
             self._verify_replay(req, 0, int(tok[i]))
             live.append(req)
         # feed generated tokens 0..K-2 back through the decode program,
@@ -892,16 +1056,20 @@ class ContinuousScheduler:
             pos = np.full((W,), -1, np.int32)
             tables = np.zeros((W, self.table_blocks), np.int32)
             gi = np.zeros((W,), np.int32)
+            aids = np.full((W,), -1, np.int32)
             keys = [self._pad_key] * W
             for req in step_reqs:
                 i = req.slot
                 prev[i] = req.tokens[k - 1]
                 pos[i] = req.prompt.size + k - 1
-                tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+                ids = self._table_ids(req)
+                tables[i, : len(ids)] = ids
                 gi[i] = k
+                aids[i] = req.adapter
                 keys[i] = req.row_key
             tok, finite, self._pool = self._fns.decode_step(
-                self.params, self._pool, prev, pos, tables, jnp.stack(keys), gi,
+                self._qparams if self._quant else self.params,
+                self._pool, prev, pos, tables, jnp.stack(keys), gi, aids,
             )
             tok = np.asarray(tok)
             finite = np.asarray(finite)
@@ -1017,6 +1185,7 @@ class ContinuousScheduler:
         pos = np.full((W,), -1, np.int32)
         tables = np.zeros((W, self.table_blocks), np.int32)
         gen_idx = np.zeros((W,), np.int32)
+        aids = np.full((W,), -1, np.int32)
         keys = [self._pad_key] * W
         for req in reqs:
             i = req.slot
@@ -1024,10 +1193,12 @@ class ContinuousScheduler:
             # prev = generated token gen_idx-1 at global position
             # prompt_len + gen_idx - 1; feeding it samples token gen_idx
             pos[i] = req.prompt.size + req.gen_idx - 1
-            tables[i, : len(req.admission.block_ids)] = req.admission.block_ids
+            ids = self._table_ids(req)
+            tables[i, : len(ids)] = ids
             gen_idx[i] = req.gen_idx
+            aids[i] = req.adapter
             keys[i] = req.row_key
-        return prev, pos, tables, gen_idx, keys
+        return prev, pos, tables, gen_idx, aids, keys
 
     def _poison_shim(self, reqs: List[_PagedRequest]) -> None:
         """Injected per-request dispatch failure (``serve_raise``).  The
@@ -1044,15 +1215,16 @@ class ContinuousScheduler:
         t0 = time.perf_counter()
         active = [req for req in self._slots if req is not None]
         self._poison_shim(active)
-        prev, pos, tables, gen_idx, keys = self._decode_arrays(active)
+        prev, pos, tables, gen_idx, aids, keys = self._decode_arrays(active)
         n_active = len(active)
         # the span marks this tick as PRODUCTIVE serving work — the
         # serve-side MTTR endpoint (telemetry/slo.py pairs it with the
         # preceding poison_bisect/serving_restart recovery span)
         with span("decode_step", step=self._tick_no, active=n_active):
             tok, finite, self._pool = self._fns.decode_step(
-                self.params, self._pool, prev, pos, tables,
-                jnp.stack(keys), gen_idx,
+                self._qparams if self._quant else self.params,
+                self._pool, prev, pos, tables,
+                jnp.stack(keys), gen_idx, aids,
             )
         tok = np.asarray(tok)
         finite = np.asarray(finite)
@@ -1079,13 +1251,169 @@ class ContinuousScheduler:
         failed step's, so the pool scatter is idempotent and sampling is
         pure: probing commits nothing the real step would not."""
         self._poison_shim(reqs)
-        prev, pos, tables, gen_idx, keys = self._decode_arrays(reqs)
+        prev, pos, tables, gen_idx, aids, keys = self._decode_arrays(reqs)
         tok, _, self._pool = self._fns.decode_step(
-            self.params, self._pool, prev, pos, tables,
-            jnp.stack(keys), gen_idx,
+            self._qparams if self._quant else self.params,
+            self._pool, prev, pos, tables,
+            jnp.stack(keys), gen_idx, aids,
         )
         # surface async dispatch errors here, inside the probe's try
         jax.block_until_ready(tok)
+
+    # ------------------------------------------------------------------ #
+    # speculative decoding (serving/speculative.py)
+
+    def _spec_decode_step(self) -> None:
+        """One speculative round for every occupied slot, replacing the
+        single-token decode step: k+1 greedy draft steps on the draft
+        pool (the last a pure K/V backfill of the final proposal), one
+        batched ``verify`` on FORKED block tables, exact host-side
+        accept/reject, then commit-by-swap.  Emits 1..k+1 tokens per live
+        request; the committed stream is token-identical to plain greedy
+        decode (the parity oracle) because every emitted token is the
+        TARGET's argmax — the draft only decides how many of them one
+        target forward amortizes.
+
+        Fork mechanics: the round's verify writes positions ``P..P+ke``
+        (``P`` = the last committed token's position).  Positions beyond
+        block ``bi = P // block_size`` land in footprint blocks that hold
+        no committed data yet, so they need no protection; block ``bi``
+        DOES hold committed rows ``[bi*bs, P)``, so those are CoW-copied
+        into the request's private spare block and the verify runs on a
+        branch table with ``table[bi] := spare``.  On commit the spare
+        becomes the real block (swap inside ``block_ids`` — refcount
+        accounting unchanged); the old block becomes the next round's
+        spare, pristine until then (rollback-safety).  Rows a REJECTED
+        proposal wrote past the commit point are harmless: every verify
+        scatters all its columns before it gathers, so any position a
+        later round can read is rewritten by that round first, and
+        positions past its coverage are causally masked.
+        """
+        t0 = time.perf_counter()
+        active = [req for req in self._slots if req is not None]
+        self._poison_shim(active)
+        W = self.slots_n
+        k = self._spec.k
+        bs = self._kv.block_size
+        # clamp each row's proposal count to its remaining budget so no
+        # verify write can land past the reserved footprint
+        k_eff = {r.slot: min(k, r.max_new - r.gen_idx) for r in active}
+
+        with span("decode_step", step=self._tick_no, active=len(active)):
+            # -- draft: k+1 greedy single-token steps (step j feeds the
+            # committed tail for j=0, else proposal j-1, at position
+            # P+j, producing proposal j).  Step k_eff is a pure K/V
+            # BACKFILL: it feeds the final proposal so its position is
+            # written to the draft pool (the sample is discarded) —
+            # without it that position would stay stale forever once the
+            # proposal commits, and even a self-draft would drift off the
+            # target (acceptance < 1 for no reason) ---------------------
+            draft_tok = np.zeros((W, k), np.int32)
+            pad_keys = jnp.stack([self._pad_key] * W)
+            for j in range(k + 1):
+                prev = np.zeros((W,), np.int32)
+                pos = np.full((W,), -1, np.int32)
+                dtables = np.zeros((W, self.table_blocks), np.int32)
+                gi = np.zeros((W,), np.int32)
+                aids = np.full((W,), -1, np.int32)
+                any_row = False
+                for req in active:
+                    i = req.slot
+                    if j > k_eff[i]:
+                        continue
+                    any_row = True
+                    prev[i] = req.tokens[-1] if j == 0 else draft_tok[i, j - 1]
+                    pos[i] = req.prompt.size + req.gen_idx - 1 + j
+                    dids = req.draft_admission.block_ids
+                    dtables[i, : len(dids)] = dids
+                    gi[i] = req.gen_idx + j
+                    if self._draft_lora:
+                        aids[i] = req.adapter
+                if not any_row:
+                    break
+                tok, _, self._draft_pool = self._draft_fns.decode_step(
+                    self._draft_params, self._draft_pool, prev, pos, dtables,
+                    pad_keys, gi, aids,
+                )
+                if j < k:
+                    draft_tok[:, j] = np.asarray(tok)
+
+            # -- fork + verify: one batched target forward over
+            # [committed tail, proposals...] on branch tables ----------
+            pool_rows = self._kv.num_blocks * bs
+            src = np.full((W, bs), pool_rows, np.int32)  # OOB rows drop
+            dst = np.full((W, bs), pool_rows, np.int32)
+            ver_tok = np.zeros((W, k + 1), np.int32)
+            ver_pos = np.full((W, k + 1), -1, np.int32)
+            vtables = np.zeros((W, self.table_blocks), np.int32)
+            aids = np.full((W,), -1, np.int32)
+            offs = np.arange(bs)
+            for req in active:
+                i = req.slot
+                ke = k_eff[i]
+                P = req.prompt.size + req.gen_idx - 1
+                bi = P // bs
+                ids = self._table_ids(req)
+                spare = req.admission.block_ids[-1]
+                off = P % bs
+                if off:
+                    src[i, :off] = ids[bi] * bs + offs[:off]
+                    dst[i, :off] = spare * bs + offs[:off]
+                ver_tok[i, 0] = req.tokens[-1]
+                ver_tok[i, 1 : 1 + ke] = draft_tok[i, :ke]
+                ver_pos[i, : ke + 1] = np.arange(P, P + ke + 1)
+                vtables[i, : len(ids)] = ids
+                vtables[i, bi] = spare
+                aids[i] = req.adapter
+            self._pool = self._fns.copy_rows(
+                self._pool, src.reshape(-1), dst.reshape(-1)
+            )
+            # verify ALWAYS takes the plain tree, quant mode included:
+            # the target's scoring is the accuracy anchor
+            logits, self._pool = self._fns.verify(
+                self.params, self._pool, ver_tok, ver_pos, vtables, aids,
+            )
+            logits = np.asarray(logits)
+
+        # -- host accept/reject + commit -------------------------------
+        t1 = time.perf_counter()
+        emitted_total = proposed = accepted = 0
+        for req in active:
+            i = req.slot
+            ke = k_eff[i]
+            if not np.isfinite(logits[i, : ke + 1]).all():
+                self._evict_poisoned(
+                    req, cause=None, trigger="non-finite verify logits"
+                )
+                continue
+            target = logits[i, : ke + 1].argmax(-1).astype(np.int32)
+            n_acc, emit = greedy_accept(draft_tok[i, :ke], target)
+            if n_acc == ke and req.gen_idx + len(emit) > req.max_new:
+                emit = emit[:-1]  # no room for the bonus under the cap
+            proposed += ke
+            accepted += n_acc
+            # commit-by-swap: the branch boundary block becomes real, the
+            # displaced block becomes the next round's pristine spare
+            P = req.prompt.size + req.gen_idx - 1
+            bi = P // bs
+            ids = req.admission.block_ids
+            ids[bi], ids[-1] = ids[-1], ids[bi]
+            for t in emit:
+                self._push_token(req, int(t))
+                emitted_total += 1
+                if req.admission is None:
+                    break  # retired (eos / cap) mid-round
+        self._bump("spec_rounds")
+        if proposed:
+            self._bump("spec_proposed", proposed)
+        if accepted:
+            self._bump("spec_accepted", accepted)
+        self.metrics.record_decode(n_tokens=emitted_total, decode_s=t1 - t0)
+        self.metrics.record_iteration(
+            active_slots=len(active), total_slots=self.slots_n,
+            blocks_in_use=self._kv.blocks_in_use,
+            total_blocks=self._kv.num_blocks,
+        )
 
     # ------------------------------------------------------------------ #
     # retirement and recovery
@@ -1102,10 +1430,16 @@ class ContinuousScheduler:
         ):
             self._retire(req)
 
+    def _release_draft(self, req: _PagedRequest) -> None:
+        if req.draft_admission is not None:
+            self._dkv.release(req.draft_admission)
+            req.draft_admission = None
+
     def _retire(self, req: _PagedRequest) -> None:
         self._slots[req.slot] = None
         self._kv.release(req.admission)
         req.admission = None
+        self._release_draft(req)
         if not req.future.done():
             req.future.set_result(
                 {
@@ -1114,7 +1448,10 @@ class ContinuousScheduler:
                 }
             )
         self._bump("retired")
-        self.metrics.record_request(req.enqueued_at, gen_len=len(req.tokens))
+        self.metrics.record_request(
+            req.enqueued_at, gen_len=len(req.tokens),
+            adapter=req.adapter_name,
+        )
         if self._kv.prefix_evictions:
             # drain the pool's eviction tally into the ledger (the pool
             # itself is metrics-free bookkeeping)
@@ -1136,6 +1473,7 @@ class ContinuousScheduler:
         self._slots[req.slot] = None
         self._kv.release(req.admission)
         req.admission = None
+        self._release_draft(req)
         if not req.future.done():
             req.future.set_exception(err)
         self._bump("requests_poisoned")
@@ -1168,6 +1506,7 @@ class ContinuousScheduler:
             if req.admission is not None:
                 self._kv.release(req.admission)
                 req.admission = None
+            self._release_draft(req)
             if not req.future.done():
                 req.future.set_exception(exc)
         if doomed:
@@ -1185,11 +1524,12 @@ class ContinuousScheduler:
                 # the reservation indexes the DEAD pool: drop it without
                 # release — allocator and prefix cache are rebuilt below
                 req.admission = None
+                req.draft_admission = None
                 req.slot = -1
                 self._queue.appendleft(req)
         self._fns = build_paged_fns(
             self._model, self._block_size, self._num_blocks,
-            temperature=self._temperature,
+            temperature=self._temperature, quant=self._quant,
         )
         self._kv = PagedKVPool(
             self._num_blocks, self._block_size, self._prefix_cache
@@ -1197,10 +1537,33 @@ class ContinuousScheduler:
         self._pool = self._fns.init_pool(self.params)
         if self._pool_sharding is not None:
             self._pool = jax.device_put(self._pool, self._pool_sharding)
+        if self._spec is not None:
+            # the draft side restarts with the target: fresh programs,
+            # fresh pool, fresh allocator (requests re-prefill both)
+            self._build_draft()
         if self._watchdog is not None:
             # the rebuilt programs recompile on first use — re-enter
             # warmup or the compile stall reads as another hang
             self._watchdog.reset()
+
+    def _build_draft(self) -> None:
+        """(Re)build the speculative draft side: its own compiled program
+        set over its OWN paged pool (prefix cache off — draft K/V and
+        target K/V must never share rows, and draft blocks are private to
+        their request).  The draft is always greedy regardless of the
+        engine temperature; speculative mode itself requires greedy."""
+        self._draft_fns = build_paged_fns(
+            self._draft_model, self._block_size, self._num_blocks,
+            temperature=0.0,
+        )
+        self._dkv = PagedKVPool(
+            self._num_blocks, self._block_size, prefix_cache=False
+        )
+        self._draft_pool = self._draft_fns.init_pool(self._draft_params)
+        if self._pool_sharding is not None:
+            self._draft_pool = jax.device_put(
+                self._draft_pool, self._pool_sharding
+            )
 
     def _on_tick_hang(self, step: int, elapsed: float, limit: float) -> None:
         # runs on the watchdog monitor thread: record the diagnosis; the

@@ -1,0 +1,131 @@
+"""Speculative decoding: draft-proposed tokens, target-verified exactly.
+
+Decode is latency-bound because each new token costs one full serial
+forward pass of the target model.  Speculative decoding (Leviathan et
+al. 2023; Chen et al. 2023) breaks the serial chain: a cheap DRAFT model
+proposes ``k`` tokens autoregressively, then the target model scores all
+``k+1`` positions in ONE batched forward (the ``verify`` program in
+serving/decode.py — prefill-shaped, full logits out) and the host keeps
+the longest prefix the target agrees with.  Every committed token is the
+TARGET's own choice, so the output distribution is exactly the target
+model's — the draft only decides how many target-forwards one round
+amortizes.
+
+The scheduler (serving/scheduler.py) runs the greedy (temperature-0)
+specialization: the draft proposes its argmax chain, the target's
+per-position argmax is computed host-side from the verify logits, and
+:func:`greedy_accept` keeps proposals while they match — equivalent to
+the general rule below with a point-mass draft distribution, and what
+makes the committed stream token-identical to plain greedy decode (the
+parity oracle).  :func:`sampled_accept` is the full Leviathan
+rejection-sampling rule for temperature > 0, kept as a pure,
+unit-tested function until the scheduler grows a sampled mode.
+
+:class:`SpeculativeSpec` carries the engine's choices: ``k`` and an
+optional dedicated draft model + params.  No draft configured means
+SELF-draft (draft == target): useless for speedup, but its acceptance
+rate is 1.0 by construction — the end-to-end pin that verification and
+pool forking are exact.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+__all__ = ["SpeculativeSpec", "greedy_accept", "sampled_accept"]
+
+
+class SpeculativeSpec:
+    """Engine-level speculative config: draft length + draft model.
+
+    ``draft_model``/``draft_params`` come as a pair or not at all (absent
+    = self-draft).  The draft gets its OWN compiled program set and its
+    OWN paged pool in the scheduler — draft K/V and target K/V must never
+    share rows.
+    """
+
+    __slots__ = ("k", "draft_model", "draft_params")
+
+    def __init__(self, k: int, draft_model=None, draft_params=None):
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"serving.speculative.k must be >= 1, got {k}")
+        if (draft_model is None) != (draft_params is None):
+            raise ValueError(
+                "draft_model and draft_params must be given together"
+            )
+        self.k = k
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+
+
+def greedy_accept(draft_tokens, target_tokens) -> Tuple[int, List[int]]:
+    """Temperature-0 accept rule: ``(n_accepted, emitted_tokens)``.
+
+    ``draft_tokens`` are the draft's ``k`` proposals for generated-token
+    indices ``g .. g+k-1``; ``target_tokens`` are the target's argmax at
+    the ``k+1`` verify positions (``target_tokens[j]`` is the target's
+    choice for index ``g+j``, the bonus row included).  Proposals are
+    kept while they equal the target's choice; the first mismatch emits
+    the target's correction and stops; a clean sweep emits the bonus.
+    Every emitted token is the target's argmax, so
+    ``1 <= len(emitted) <= k+1`` and the committed stream equals plain
+    greedy decode regardless of the draft.  (The caller trims the bonus
+    when the per-request ``max_new`` cap has no room for it.)
+    """
+    draft = [int(t) for t in draft_tokens]
+    target = [int(t) for t in target_tokens]
+    if len(target) != len(draft) + 1:
+        raise ValueError(
+            f"need k+1 target tokens for k draft tokens, got "
+            f"{len(target)} for {len(draft)}"
+        )
+    emitted: List[int] = []
+    for j, d in enumerate(draft):
+        t = target[j]
+        emitted.append(t)
+        if d != t:
+            return j, emitted
+    emitted.append(target[len(draft)])
+    return len(draft), emitted
+
+
+def sampled_accept(
+    draft_tokens, draft_probs, target_probs, rng: np.random.Generator
+) -> Tuple[int, List[int]]:
+    """Leviathan rejection sampling: ``(n_accepted, emitted_tokens)``.
+
+    ``draft_probs`` [k, V] are the draft's sampling distributions q, one
+    per proposal; ``target_probs`` [k+1, V] the target's p at the verify
+    positions.  Proposal ``d_j`` is accepted with probability
+    ``min(1, p_j(d_j) / q_j(d_j))``; on rejection a correction is drawn
+    from the residual ``normalize(max(p_j - q_j, 0))`` and the round
+    stops; a clean sweep draws the bonus from ``p_k``.  The emitted
+    marginals are EXACTLY p — the property that makes speculative
+    decoding a latency optimization rather than an approximation.  With
+    a point-mass q (greedy draft) this degenerates to
+    :func:`greedy_accept` in distribution.
+    """
+    draft = [int(t) for t in draft_tokens]
+    p = np.asarray(target_probs, np.float64)
+    q = np.asarray(draft_probs, np.float64)
+    if p.ndim != 2 or q.ndim != 2 or p.shape[0] != len(draft) + 1:
+        raise ValueError(
+            f"need target_probs [k+1, V] and draft_probs [k, V], got "
+            f"{p.shape} / {q.shape} for k={len(draft)}"
+        )
+    emitted: List[int] = []
+    for j, d in enumerate(draft):
+        accept = min(1.0, p[j, d] / max(q[j, d], 1e-300))
+        if rng.random() < accept:
+            emitted.append(d)
+            continue
+        resid = np.maximum(p[j] - q[j], 0.0)
+        z = resid.sum()
+        dist = resid / z if z > 0.0 else p[j] / p[j].sum()
+        emitted.append(int(rng.choice(dist.size, p=dist)))
+        return j, emitted
+    bonus = p[len(draft)] / p[len(draft)].sum()
+    emitted.append(int(rng.choice(bonus.size, p=bonus)))
+    return len(draft), emitted
