@@ -64,6 +64,8 @@ _SEED_PARAMS = {
     "quant": ("serving", "quant"),
     "lora": ("serving", "lora"),
     "speculative": ("serving", "speculative"),
+    "autoscale": ("serving", "autoscale"),
+    "workload": ("serving", "autoscale", "workload"),
 }
 _ACCESS_METHODS = {"get", "pop", "setdefault"}
 _CASTS = {"int", "float", "bool", "str"}

@@ -58,6 +58,7 @@ BENCH_DRIVERS = (
     "bench_fleet_serve(",
     "bench_soak(",
     "bench_serve_modes(",
+    "bench_autoscale(",
 )
 
 FAULT_MACHINERY = (

@@ -78,10 +78,11 @@ __all__ = [
     "Scenario",
     "ScenarioGenerator",
     "coverage_matrix",
+    "scaling_cells",
     "uncovered_kinds",
 ]
 
-FAMILIES = ("train", "serve", "elastic", "fleet")
+FAMILIES = ("train", "serve", "elastic", "fleet", "scaling")
 
 OVERLAP_MODES = ("sequential", "adjacent", "concurrent")
 
@@ -135,6 +136,8 @@ FAULT_MENU: Dict[str, FaultKind] = {
                   ("serving_fleet_replicas_down",), parity=True),
         FaultKind("replica_hang", "fleet", "heartbeat_staleness_failover",
                   ("injected_replica_hangs",), parity=True),
+        FaultKind("autoscale_hang", "scaling", "decision_reread_after_hang",
+                  ("injected_autoscale_hangs",), parity=True),
     )
 }
 
@@ -161,9 +164,28 @@ def uncovered_kinds() -> List[str]:
     covered = set()
     for fam in FAMILIES:
         for template in _TEMPLATES[fam]:
-            covered.update(template)
+            # scaling atoms carry a phase prefix ("up:replica_down");
+            # coverage is about the KIND, whatever window it lands in
+            covered.update(a.split(":")[-1] for a in template)
     return sorted((set(registered_fault_kinds()) | set(FAULT_MENU))
                   - covered)
+
+
+def scaling_cells() -> Dict[str, List[str]]:
+    """``scaling-event phase -> fault kinds`` the scenario space can land
+    in that window.  The three phases are the scaling-event cells of the
+    coverage matrix: ``scale_up`` (fault mid-flash-crowd while capacity
+    is being added), ``drain`` (fault inside a scale-down drain), and
+    ``decision`` (the autoscaler's own control loop wedged).  Pinned
+    non-empty by tier-1 so scaling coverage cannot silently regress."""
+    cells: Dict[str, set] = {"scale_up": set(), "drain": set(),
+                             "decision": set()}
+    phase_of = {"up": "scale_up", "drain": "drain", "decision": "decision"}
+    for template in _TEMPLATES["scaling"]:
+        for atom in template:
+            phase, _, kind = atom.partition(":")
+            cells[phase_of[phase]].add(kind)
+    return {k: sorted(v) for k, v in cells.items()}
 
 
 # ------------------------------------------------------------------ scenarios
@@ -237,6 +259,16 @@ _TEMPLATES: Dict[str, Tuple[Tuple[str, ...], ...]] = {
     "fleet": (
         ("replica_down", "serve_device_lost"),
         ("replica_hang", "serve_device_lost"),
+    ),
+    # scaling atoms are "<phase>:<kind>": the phase names the scaling-
+    # event window the fault must land in (scale-up mid-flash-crowd,
+    # scale-down drain, autoscaler decision poll) — _run_scaling installs
+    # each phase's entries only once its window opens
+    "scaling": (
+        ("up:replica_down", "decision:autoscale_hang"),
+        ("drain:serve_nan", "decision:autoscale_hang"),
+        ("up:replica_down", "drain:serve_raise"),
+        ("drain:serve_raise", "decision:autoscale_hang"),
     ),
 }
 
@@ -405,6 +437,33 @@ class ScenarioGenerator:
                 ))
         return entries
 
+    def _place_scaling(self, rng: Random, template: Tuple[str, ...],
+                       overlap: str) -> List[FaultEntry]:
+        """One entry per phase-prefixed atom, IN TEMPLATE ORDER (the
+        runner recovers each entry's phase by zipping the template with
+        the entries).  Steps are window-relative: _run_scaling shifts
+        them past whatever the warmup consumed when the window opens."""
+        del overlap  # phases impose the temporal structure here
+        entries = []
+        for atom in template:
+            phase, _, kind = atom.partition(":")
+            if phase == "decision":
+                # the autoscaler's FIRST poll is the scale-up decision
+                # mid-flash-crowd — the one worth wedging.  The hang must
+                # be long enough that the world visibly moved under the
+                # sleeping controller, short enough to keep the soak fast.
+                entries.append(FaultEntry(
+                    kind, 1, f"{rng.uniform(0.3, 0.6):.2f}"
+                ))
+            elif phase == "up":
+                # kill the replica the scale-up just added (index 1 — the
+                # first replica ever added to a 1-replica fleet) while
+                # flash-crowd requests are in flight on it
+                entries.append(FaultEntry(kind, rng.randint(1, 3), "1"))
+            else:  # drain: poison a decoding slot mid-scale-down-drain
+                entries.append(FaultEntry(kind, rng.randint(1, 2), "0"))
+        return entries
+
     # ------------------------------------------------------------ generation
     def generate(self, n: int) -> List[Scenario]:
         """``n`` scenarios, round-robin over the configured families.
@@ -420,6 +479,7 @@ class ScenarioGenerator:
             "serve": self._place_serve,
             "elastic": self._place_elastic,
             "fleet": self._place_fleet,
+            "scaling": self._place_scaling,
         }
         out: List[Scenario] = []
         for i in range(n):
@@ -1083,6 +1143,259 @@ class ChaosSoakEngine:
         if leaked:
             failures.append(f"leaked threads: {leaked}")
 
+    # -------------------------------------------------------------- scaling
+    def _run_scaling(self, scn: Scenario, result: Dict,
+                     failures: List[str]) -> None:
+        """Faults landing INSIDE autoscaler scaling events.
+
+        A 1-replica fleet under a FleetAutoscaler rides a synthetic flash
+        crowd through three windows, installing each phase's faults only
+        when its window opens (stage-wise installs — a later window's
+        fault must not fire early against the warmup's polls):
+
+        - *decision*: ``autoscale_hang`` wedges the scale-up poll itself;
+          the contract is that the post-hang decision runs on FRESHLY
+          re-read signals, so the crowd may simply re-pressure the next
+          poll — the run keeps submitting until capacity arrives.
+        - *scale-up*: ``replica_down`` kills the replica the scale-up
+          just added, mid-crowd; the router must fail its in-flight work
+          over with token-identical replay and the autoscaler must
+          re-grow capacity.
+        - *drain*: ``serve_nan``/``serve_raise`` (SDC / poison) land
+          while the scale-down drain is running requests to completion.
+
+        Parity oracle: temperature 0 makes every stream a pure function
+        of its prompt, so each unpoisoned request is checked against a
+        clean 1-replica reference fleet replaying the same prompts —
+        placement-, scale-, and failover-independent by construction.
+        """
+        import copy
+
+        import numpy as np
+
+        from ..config_parsing import get_serve_cfg
+        from ..serving import ServingFleet
+        from ..serving.autoscaler import FleetAutoscaler
+
+        base = get_serve_cfg(
+            os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+        )
+        base["serving"]["scheduler"] = {
+            "enabled": True, "slots": 4, "block_size": 4, "num_blocks": 64,
+            "prefix_cache": True,
+        }
+        base["serving"]["resilience"] = {
+            "max_restarts": 3, "poison_bisect": True,
+            "drain_deadline_ms": 60_000,
+        }
+        base["serving"]["fleet"] = {
+            "replicas": 1, "affinity": True,
+            "heartbeat_timeout_s": 30.0, "poll_interval_s": 0.02,
+        }
+        base["serving"]["temperature"] = 0.0
+        # thresholds shaped for the soak's burst arithmetic: an 8-request
+        # flash crowd clears backlog_high; a 4-request trickle sits under
+        # backlog_low, and occupancy_low=1.0 admits a scale-down WITH
+        # requests still decoding — which is the whole point of the drain
+        # window (real deployments would set occupancy_low well below 1)
+        autoscale_cfg = {
+            "min_replicas": 1, "max_replicas": 2,
+            "backlog_high": 7, "backlog_low": 6,
+            "occupancy_high": 1.5, "occupancy_low": 1.0,
+            "scale_up_cooldown_s": 0.0, "scale_down_cooldown_s": 0.0,
+            "drain_deadline_ms": 60_000,
+        }
+        phases: Dict[str, List[FaultEntry]] = {
+            "up": [], "decision": [], "drain": [],
+        }
+        for atom, entry in zip(scn.template, scn.entries):
+            phases[atom.partition(":")[0]].append(entry)
+
+        vocab = base["dataset"]["n_classes"]
+        fault.reset_counters()
+        baseline = self._thread_baseline()
+        rng = np.random.default_rng(0)
+        cfg = copy.deepcopy(base)
+        fleet = ServingFleet.from_config(cfg)
+        asc = FleetAutoscaler(fleet, dict(autoscale_cfg))
+        stage_leaks: List[str] = []
+
+        def install_stage(entries: List[FaultEntry], offset_of) -> None:
+            """Swap the injector to this window's faults; the previous
+            window must have fully fired (a pending fault would be
+            silently discarded by the swap — that is a failure)."""
+            left = fault.get_injector().pending()
+            if left:
+                stage_leaks.extend(left)
+            fault.install(";".join(
+                FaultEntry(e.kind, e.step + offset_of(e.kind), e.arg).render()
+                for e in entries
+            ) or None)
+
+        try:
+            seq_max = fleet.replicas[0].seq_buckets[-1]
+            mnt = min(4, fleet.replicas[0].max_new_tokens)
+            warm = rng.integers(2, vocab, seq_max // 2).astype(np.int32)
+            fleet.replicas[0].submit(warm).result(timeout=600)
+
+            submitted: List = []  # (prompt, future)
+
+            def burst(k: int) -> None:
+                for _ in range(k):
+                    ln = int(rng.integers(1, seq_max + 1))
+                    prompt = rng.integers(2, vocab, ln).astype(np.int32)
+                    submitted.append(
+                        (prompt, fleet.submit(prompt, max_new_tokens=mnt)))
+
+            def pressure_up(tag: str) -> None:
+                """Flash-crowd until the autoscaler adds capacity (the
+                decision hang may legitimately defer it a round: fresh
+                post-hang signals saw the first burst already absorbed)."""
+                for _ in range(4):
+                    if fleet.live_replicas() >= 2:
+                        return
+                    burst(8)
+                    asc.poll()
+                if fleet.live_replicas() < 2:
+                    failures.append(f"{tag}: autoscaler never scaled up")
+
+            # ---- window 1: decision (+ the scale-up it wedges)
+            install_stage(phases["decision"], lambda k: 0)
+            pressure_up("decision window")
+            new_idx = max(fleet.router.live_indices())
+            if new_idx > 0:  # warm the fresh replica outside fault windows
+                fleet.replicas[new_idx].submit(warm).result(timeout=600)
+
+            # ---- window 2: replica death mid-crowd, post-scale-up
+            if phases["up"]:
+                burst(4)  # the crowd keeps arriving; some land on the
+                # new replica — these are the streams the kill must not
+                # corrupt
+                poll0 = fleet.router._poll_no
+                install_stage(phases["up"], lambda k: poll0)
+                for _, f in submitted:  # failover completes them
+                    f.result(timeout=600)
+                # capacity healing: the crowd is still the sizing signal
+                pressure_up("post-kill heal")
+
+            for _, f in submitted:
+                f.result(timeout=600)
+
+            # ---- window 3: scale-down drain with work in flight
+            n_before = len(submitted)
+            burst(4)
+            # drain faults are tick-keyed on the replica the scale-down
+            # will retire (the highest live index — pick_retire_candidate
+            # is LIFO): it is the scheduler that ticks through the drain
+            # window, so ITS counter is the one that reaches the step
+            retiree = fleet.pick_retire_candidate()
+            tick0 = fleet.replicas[retiree].scheduler._tick_no
+            install_stage(phases["drain"],
+                          lambda k: tick0 if k.startswith("serve_") else 0)
+            decision = asc.poll()  # blocks through the retiree's drain
+            if decision != "down":
+                failures.append(
+                    f"scale-down poll decided {decision!r}, not 'down'"
+                )
+            results: List = []
+            for prompt, f in submitted:
+                try:
+                    results.append(
+                        (prompt,
+                         tuple(int(t) for t in f.result(timeout=600)["tokens"]))
+                    )
+                except Exception as e:
+                    results.append((prompt, type(e).__name__))
+            install_stage([], lambda k: 0)  # surface window-3 leftovers
+            fired = dict(fault.counters())
+        finally:
+            fault.install(None)
+            fleet.close()
+
+        result["counters"] = {k: v for k, v in fired.items() if v}
+        result["scale_ups"] = asc.scale_ups
+        result["scale_downs"] = asc.scale_downs
+        if stage_leaks:
+            failures.append(f"faults never fired: {sorted(stage_leaks)}")
+        if asc.scale_ups < 1 or asc.scale_downs < 1:
+            failures.append(
+                f"scaling events missing: {asc.scale_ups} up(s), "
+                f"{asc.scale_downs} down(s)"
+            )
+        # recovery attribution per kind (fleet mode mirrors serve
+        # counters per replica)
+        n_reps = len(fleet.replicas)
+        for kind in scn.kinds():
+            menu = FAULT_MENU[kind]
+            moved = any(fired.get(c, 0) > 0 for c in menu.counters)
+            if not moved and kind.startswith("serve_"):
+                moved = any(
+                    fired.get(f"serving_r{r}_{c}", 0) > 0
+                    for r in range(n_reps) for c in menu.counters
+                )
+            if not moved:
+                failures.append(
+                    f"{kind}: no recovery attribution (none of "
+                    f"{menu.counters} moved)"
+                )
+        # poison accounting: each injected poison fault costs exactly one
+        # request; everything else must have completed
+        n_poison = sum(
+            1 for e in scn.entries if e.kind in ("serve_nan", "serve_raise")
+        )
+        poisoned = [i for i, (_, r) in enumerate(results)
+                    if isinstance(r, str)]
+        if len(poisoned) != n_poison:
+            failures.append(
+                f"poison attribution: {n_poison} poison fault(s) injected "
+                f"but {len(poisoned)} request(s) failed "
+                f"({[results[i][1] for i in poisoned]})"
+            )
+        if poisoned and min(poisoned) < n_before:
+            failures.append(
+                "a pre-drain-window request was poisoned (drain faults "
+                "leaked backwards)"
+            )
+        # parity: greedy streams depend only on the prompt — replay every
+        # unpoisoned prompt through a clean static reference fleet
+        ref_cache = self._twins.setdefault(("scaling_ref",), {})
+        missing = [
+            tuple(int(t) for t in p)
+            for i, (p, r) in enumerate(results)
+            if i not in set(poisoned)
+            and (tuple(int(t) for t in p), mnt) not in ref_cache
+        ]
+        if missing:
+            ref_fleet = ServingFleet.from_config(copy.deepcopy(base))
+            try:
+                ref_fleet.replicas[0].submit(warm).result(timeout=600)
+                futs = [
+                    (p, ref_fleet.submit(
+                        np.asarray(p, np.int32), max_new_tokens=mnt))
+                    for p in dict.fromkeys(missing)
+                ]
+                for p, f in futs:
+                    ref_cache[(p, mnt)] = tuple(
+                        int(t) for t in f.result(timeout=600)["tokens"])
+            finally:
+                ref_fleet.close()
+        diverged = 0
+        for i, (p, r) in enumerate(results):
+            if i in set(poisoned):
+                continue
+            want = ref_cache[(tuple(int(t) for t in p), mnt)]
+            if r != want:
+                diverged += 1
+                failures.append(
+                    f"request {i} tokens diverged from reference after "
+                    "scaling"
+                )
+        result["parity"] = diverged == 0
+        result["requests"] = len(results)
+        leaked = self._leaked_threads(baseline)
+        if leaked:
+            failures.append(f"leaked threads: {leaked}")
+
     # ------------------------------------------------------------------ run
     def run_scenario(self, scn: Scenario) -> Dict:
         t0 = time.monotonic()
@@ -1098,6 +1411,7 @@ class ChaosSoakEngine:
             "serve": self._run_serve,
             "elastic": self._run_elastic,
             "fleet": self._run_fleet,
+            "scaling": self._run_scaling,
         }[scn.family]
         try:
             runner(scn, result, failures)

@@ -86,6 +86,17 @@ Fleet-side kinds (the ``step`` is the fleet router's monitor POLL index,
                        can see it; the router must mark the replica
                        unhealthy and hedge/fail over around it
 
+Autoscaler-level kinds (P = autoscaler poll index, 1-based —
+serving/autoscaler.py consults the injector once per control-loop poll):
+
+    autoscale_hang@P[:SEC]
+                       wedge the autoscaler's decision path for SEC
+                       (default 1.0) seconds at its poll P — the world
+                       keeps moving (flash crowd grows, replicas die)
+                       while the controller sleeps; recovery contract is
+                       that signals are re-read fresh AFTER the hang, so
+                       a stale pre-hang view never drives a scale action
+
 Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/
 ``sdc_flip``/``ckpt_corrupt``/the ``serve_*`` and ``replica_*``
 families) are one-shot:
@@ -128,7 +139,7 @@ _STEP_KINDS = (
     "nan_batch", "kill_worker", "stall_step", "kill_peer",
     "sdc_flip", "ckpt_corrupt",
     "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
-    "replica_down", "replica_hang",
+    "replica_down", "replica_hang", "autoscale_hang",
 )
 _POINT_KINDS = {
     "ckpt_fail": "ckpt_save",
@@ -207,7 +218,8 @@ class FaultInjector:
             elif kind == "kill_peer":
                 # arg = target process index; -1 = whichever rank parses it
                 val = float(int(arg)) if arg is not None else -1.0
-            elif kind in ("stall_step", "serve_hang", "replica_hang"):
+            elif kind in ("stall_step", "serve_hang", "replica_hang",
+                          "autoscale_hang"):
                 val = float(arg) if arg is not None else 1.0
             else:  # nan_batch / serve_device_lost / ckpt_corrupt take no arg
                 if arg is not None:

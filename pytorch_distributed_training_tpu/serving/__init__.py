@@ -22,11 +22,16 @@ lever — saturate the accelerator by batching — to inference:
     replay, hedged re-dispatch, fleet backpressure.
   - :mod:`.fleet`    — :class:`ServingFleet`: replica lifecycle (one
     checkpoint restore, N engines), concurrent drain, SIGTERM handler,
-    aggregate health/metrics.
+    aggregate health/metrics, elastic add/remove of replicas.
+  - :mod:`.workload` — :class:`TraceGenerator`: seeded diurnal +
+    flash-crowd request traces (pure function of the seed).
+  - :mod:`.autoscaler` — :class:`FleetAutoscaler`: SLO-driven replica
+    scaling; grows via the shared restore, shrinks only through drain.
 
 ``python -m pytorch_distributed_training_tpu.serving --config
 config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
 """
+from .autoscaler import FleetAutoscaler
 from .batcher import DynamicBatcher
 from .decode import build_generate_fn, build_paged_fns
 from .engine import InferenceEngine
@@ -41,12 +46,14 @@ from .resilience import (
 )
 from .router import FleetDownError, FleetRouter, ReplicaDownError
 from .scheduler import ContinuousScheduler
+from .workload import TraceGenerator, TraceRequest
 
 __all__ = [
     "BlockAllocator",
     "ContinuousScheduler",
     "DynamicBatcher",
     "EngineRestartError",
+    "FleetAutoscaler",
     "FleetDownError",
     "FleetRouter",
     "HungTickError",
@@ -57,6 +64,8 @@ __all__ = [
     "ServingFleet",
     "ServingMetrics",
     "ServingSupervisor",
+    "TraceGenerator",
+    "TraceRequest",
     "aggregate_snapshots",
     "build_generate_fn",
     "build_paged_fns",

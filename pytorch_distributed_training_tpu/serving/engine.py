@@ -125,10 +125,19 @@ class InferenceEngine:
         spec_k = int(spec_cfg.pop("k", 4))
         spec_draft = spec_cfg.pop("draft", None)
         spec_draft_seed = int(spec_cfg.pop("draft_seed", 0))
+        spec_min_acceptance = float(spec_cfg.pop("min_acceptance", 0.0))
         if spec_cfg:
             raise ValueError(
                 f"unknown serving.speculative keys: {sorted(spec_cfg)}"
             )
+        if not 0.0 <= spec_min_acceptance <= 1.0:
+            raise ValueError(
+                "serving.speculative.min_acceptance must be in [0, 1], "
+                f"got {spec_min_acceptance}"
+            )
+        # the metrics object owns the one-shot floor warning: acceptance
+        # is only measurable where spec_proposed/spec_accepted live
+        self.metrics.spec_min_acceptance = spec_min_acceptance
         use_sched = is_lm and bool((scheduler or {}).get("enabled", False))
         if (use_quant or use_lora or use_spec) and not is_lm:
             raise ValueError("serving.quant/lora/speculative are LM-only")

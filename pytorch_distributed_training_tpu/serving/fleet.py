@@ -55,15 +55,30 @@ class ServingFleet:
         router: FleetRouter,
         heartbeat_dir: Optional[str] = None,
         logger: Optional[logging.Logger] = None,
+        replica_factory: Optional[Callable[[int], Any]] = None,
     ):
         if not replicas:
             raise ValueError("ServingFleet needs at least one replica")
-        self.replicas = list(replicas)
+        # mirrors the router's append-only list (same stable indices);
+        # the autoscaler appends while drain handlers iterate
+        self._replicas = list(replicas)  # guarded by: self._close_lock
+        self._removed: set = set()  # guarded by: self._close_lock
         self.router = router
         self.heartbeat_dir = heartbeat_dir
         self.logger = logger or logging.getLogger("pdt.serving.fleet")
+        # builds one started replica for a given replica_id — the
+        # autoscaler's scale-up path; from_config installs one that
+        # reuses its single checkpoint resolution
+        self.replica_factory = replica_factory
+        self._next_replica_id = len(self._replicas)
         self._closed = False
         self._close_lock = threading.Lock()
+
+    @property
+    def replicas(self):
+        """Locked snapshot, index-aligned with the router's list."""
+        with self._close_lock:
+            return list(self._replicas)
 
     # ------------------------------------------------------------------ #
 
@@ -106,18 +121,22 @@ class ServingFleet:
         if heartbeat_dir is None:
             heartbeat_dir = tempfile.mkdtemp(prefix="pdt-fleet-hb-")
         os.makedirs(heartbeat_dir, exist_ok=True)
-        replicas = []
-        for i in range(n):
+
+        def _make_replica(rid: int) -> InferenceEngine:
+            # closes over the ONE resolution: an autoscaled replica is
+            # built from the very same restored tree/mesh/kwargs as the
+            # originals, just stamped with the next fleet identity
             kw = dict(kwargs)
             kw.update(
-                replica_id=i,
+                replica_id=rid,
                 heartbeat_path=os.path.join(
-                    heartbeat_dir, f"replica_{i}.json"),
+                    heartbeat_dir, f"replica_{rid}.json"),
                 heartbeat_interval_s=hb_interval,
                 liveness_timeout_s=liveness,
             )
-            replicas.append(
-                InferenceEngine(model, params, batch_stats, mesh, **kw))
+            return InferenceEngine(model, params, batch_stats, mesh, **kw)
+
+        replicas = [_make_replica(i) for i in range(n)]
         router = FleetRouter(
             replicas,
             seed=int(serve.get("seed", 0)),
@@ -133,7 +152,7 @@ class ServingFleet:
             "serving fleet up: %d replica(s), affinity=%s, hedge_ms=%s, "
             "heartbeats in %s", n, affinity, hedge_ms, heartbeat_dir)
         return cls(replicas, router, heartbeat_dir=heartbeat_dir,
-                   logger=logger)
+                   logger=logger, replica_factory=_make_replica)
 
     # ------------------------------------------------------------------ #
     # client verbs (router passthrough)
@@ -169,6 +188,85 @@ class ServingFleet:
         return {"fleet": aggregate_snapshots(per), "replicas": per}
 
     # ------------------------------------------------------------------ #
+    # elastic membership (the autoscaler's two verbs)
+
+    def live_replicas(self) -> int:
+        """Replicas usable for placement: not down, not retired."""
+        return len(self.router.live_indices())
+
+    def pick_retire_candidate(self) -> Optional[int]:
+        """Which replica a scale-down should take: the HIGHEST live
+        index (LIFO — burst capacity added last leaves first, so the
+        long-lived low indices keep their warm prefix caches and sticky
+        placement).  None when only one live replica remains."""
+        live = self.router.live_indices()
+        if len(live) <= 1:
+            return None
+        return max(live)
+
+    def add_replica(self) -> int:
+        """Scale up by one replica, built by the stored factory from the
+        SAME config resolution as the original fleet (checkpoint is not
+        re-read).  Returns the new replica's stable index.  The replica
+        joins placement immediately — callers wanting a warm cache
+        submit a priming request themselves."""
+        if self.replica_factory is None:
+            raise RuntimeError(
+                "fleet has no replica_factory (build via from_config, or "
+                "pass replica_factory= to the constructor) — cannot scale "
+                "up"
+            )
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("fleet is closed")
+            rid = self._next_replica_id
+            self._next_replica_id = rid + 1
+        rep = self.replica_factory(rid)
+        try:
+            idx = self.router.add_replica(rep)
+        except BaseException:
+            rep.close()
+            raise
+        with self._close_lock:
+            self._replicas.append(rep)
+        if idx != rid:  # both lists are append-only; drift is a bug
+            self.logger.error(
+                "fleet/router replica index drift: router says %d, fleet "
+                "says %d", idx, rid)
+        self.logger.info("fleet scaled up to replica %d", idx)
+        return idx
+
+    def remove_replica(self, idx: int,
+                       deadline_ms: Optional[float] = None) -> float:
+        """Scale down replica ``idx`` through the graceful path — the
+        ONLY path: retire from placement, drain its in-flight requests
+        to completion (bounded by ``deadline_ms``), then close it.
+        Returns wall ms spent draining.  Token streams in flight on the
+        retiree finish on the retiree, bitwise-identical to an unscaled
+        run — scale-down inherits the drain parity oracle."""
+        self.router.retire_replica(idx)
+        with self._close_lock:
+            rep = self._replicas[idx]
+            already = idx in self._removed
+            self._removed.add(idx)
+        if already:
+            return 0.0
+        t0 = time.monotonic()
+        try:
+            rep.drain(deadline_ms)
+        finally:
+            try:
+                rep.close()
+            except Exception:
+                self.logger.exception(
+                    "replica %d close failed after drain", idx)
+        ms = (time.monotonic() - t0) * 1000.0
+        self.logger.info(
+            "fleet scaled down: replica %d drained+closed in %.1f ms",
+            idx, ms)
+        return ms
+
+    # ------------------------------------------------------------------ #
     # lifecycle
 
     def drain(self, deadline_ms: Optional[float] = None) -> float:
@@ -182,13 +280,17 @@ class ServingFleet:
             if self._closed:
                 return 0.0
             self._closed = True
+            live = [
+                (i, rep) for i, rep in enumerate(self._replicas)
+                if i not in self._removed  # already drained+closed
+            ]
         self.router.stop_submissions()
         threads = [
             threading.Thread(
                 target=rep.drain, args=(deadline_ms,),
                 name=f"fleet-drain-{i}", daemon=True,
             )
-            for i, rep in enumerate(self.replicas)
+            for i, rep in live
         ]
         for t in threads:
             t.start()
@@ -225,8 +327,12 @@ class ServingFleet:
             if self._closed:
                 return
             self._closed = True
+            live = [
+                rep for i, rep in enumerate(self._replicas)
+                if i not in self._removed
+            ]
         self.router.shutdown()
-        for rep in self.replicas:
+        for rep in live:
             try:
                 rep.close()
             except Exception:

@@ -28,6 +28,7 @@ fleet view for ``ServingFleet.snapshot()``.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -74,6 +75,14 @@ class ServingMetrics:
         # registry, one level down.  Base-model requests stay in the flat
         # instruments only.
         self._adapter_hists: Dict[str, tuple] = {}  # guarded by: self._lock
+        # speculative-decode acceptance floor (serving.speculative.
+        # min_acceptance, plumbed in by the engine): a measured rate
+        # below it makes snapshot() warn ONCE that speculation is
+        # costing latency rather than saving it — the bench round that
+        # motivated the gate measured 0.371x end-to-end throughput at a
+        # 3.4% acceptance rate.  0.0 disables the gate.
+        self.spec_min_acceptance = 0.0
+        self._spec_floor_warned = False  # guarded by: self._lock
 
     def adapter_name(self, adapter: str, name: str) -> str:
         """Registry name for adapter-scoped instrument ``name``."""
@@ -288,9 +297,23 @@ class ServingMetrics:
         # (the bonus token is free and not counted on either side)
         proposed = counters.get("spec_proposed", 0)
         if proposed:
-            out["spec_acceptance_rate"] = float(
-                counters.get("spec_accepted", 0) / proposed
-            )
+            rate = float(counters.get("spec_accepted", 0) / proposed)
+            out["spec_acceptance_rate"] = rate
+            floor = float(self.spec_min_acceptance or 0.0)
+            if floor > 0.0 and rate < floor:
+                out["spec_acceptance_below_floor"] = 1.0
+                with self._lock:
+                    warn = not self._spec_floor_warned
+                    self._spec_floor_warned = True
+                if warn:
+                    logging.getLogger(__name__).warning(
+                        "speculative acceptance rate %.1f%% is below the "
+                        "configured serving.speculative.min_acceptance "
+                        "floor %.1f%% — draft verification is costing "
+                        "decode latency, not saving it; disable "
+                        "serving.speculative or use a stronger draft",
+                        100.0 * rate, 100.0 * floor,
+                    )
         # per-adapter (multi-LoRA) views: same shape as the flat latency
         # fields, one set per tenant that retired at least one request
         with self._lock:

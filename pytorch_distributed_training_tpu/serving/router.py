@@ -43,6 +43,17 @@ list; disagreement bumps ``serving_fleet_parity_mismatch``).  A fleet
 backlog cap sheds at the router with the batcher's ``OverloadedError``
 before any replica queue saturates.
 
+**Elastic membership.**  The replica list itself is router state: the
+autoscaler appends replicas (``add_replica``) and retires them
+(``retire_replica``) while the monitor thread sweeps health and client
+threads place work.  Membership is therefore held behind ``self._lock``
+like every other mutable field — the list is APPEND-ONLY (an index is a
+stable replica identity for the life of the router) and a parallel
+``_retired`` set excludes drained-out replicas from placement, sweeps,
+failover, and the live count without ever renumbering survivors.
+Readers take a locked snapshot (:attr:`replicas`) and then call into
+replicas outside the lock, preserving the ordering rule below.
+
 Lock discipline: all router state is guarded by ``self._lock``.  The
 one ordering rule — NEVER call into a replica (``submit``/``health``/
 ``drain``/``hard_kill``; they take the scheduler's condition) while
@@ -157,7 +168,9 @@ class FleetRouter:
             raise ValueError(f"max_backlog must be >= 1, got {max_backlog}")
         if hedge_ms is not None and hedge_ms <= 0:
             raise ValueError(f"hedge_ms must be > 0, got {hedge_ms}")
-        self.replicas = list(replicas)
+        # append-only: an index is a stable replica identity forever
+        self._replicas: List[Any] = list(replicas)  # guarded by: self._lock
+        self._retired: set = set()  # guarded by: self._lock
         self.logger = logger or logging.getLogger("pdt.serving.fleet")
         self.affinity = bool(affinity)
         self.affinity_capacity = int(affinity_capacity)
@@ -188,6 +201,75 @@ class FleetRouter:
             self._monitor_thread.start()
 
     # ------------------------------------------------------------------ #
+    # membership (elastic: the autoscaler grows/shrinks the fleet while
+    # the monitor sweeps and clients place — all behind self._lock)
+
+    @property
+    def replicas(self) -> List[Any]:
+        """Locked snapshot of the replica list.  Append-only, so an
+        index taken from one snapshot stays valid against any later
+        snapshot; retired replicas remain in place (renumbering would
+        corrupt every in-flight ``_Assignment.replica_idx``)."""
+        with self._lock:
+            return list(self._replicas)
+
+    def add_replica(self, rep: Any) -> int:
+        """Join a new replica to the fleet; returns its (stable) index.
+        The replica is immediately eligible for placement, failover, and
+        hedging — callers hand over a started, warmed replica."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("fleet router is closed")
+            self._replicas.append(rep)
+            idx = len(self._replicas) - 1
+        self._bump("replicas_added")
+        self.logger.warning("replica %d joined the fleet", idx)
+        return idx
+
+    def retire_replica(self, idx: int) -> None:
+        """Remove replica ``idx`` from placement (scale-down step 1).
+
+        In-flight requests on it are left to COMPLETE — retirement is
+        not failure; the owner drains the replica afterwards, which is
+        what preserves token-identical completion.  Refuses to retire
+        the last live replica: an autoscaler bug must degrade to an
+        oversized fleet, never to an empty one."""
+        with self._lock:
+            if not 0 <= idx < len(self._replicas):
+                raise IndexError(
+                    f"no replica {idx} (fleet has {len(self._replicas)})"
+                )
+            if idx in self._retired:
+                return
+            unusable = self._down | self._retired
+            live = [
+                i for i in range(len(self._replicas)) if i not in unusable
+            ]
+            if live == [idx]:
+                raise ValueError(
+                    f"refusing to retire replica {idx}: it is the last "
+                    "live replica"
+                )
+            self._retired.add(idx)
+            # placement must not chase a retiree through the sticky map
+            for key in [k for k, v in self._sticky.items() if v == idx]:
+                del self._sticky[key]
+        self._bump("replicas_retired")
+        self.logger.warning("replica %d retired from placement", idx)
+
+    def retired(self) -> set:
+        with self._lock:
+            return set(self._retired)
+
+    def live_indices(self) -> List[int]:
+        """Indices neither down nor retired — the fleet's actual size."""
+        with self._lock:
+            unusable = self._down | self._retired
+            return [
+                i for i in range(len(self._replicas)) if i not in unusable
+            ]
+
+    # ------------------------------------------------------------------ #
     # client side
 
     def submit(
@@ -207,7 +289,7 @@ class FleetRouter:
         with self._lock:
             if self._closed:
                 raise RuntimeError("fleet router is closed")
-            live = len(self.replicas) - len(self._down)
+            live = len(self._replicas) - len(self._down | self._retired)
             if live <= 0:
                 raise FleetDownError("every replica is down")
             if (
@@ -224,7 +306,7 @@ class FleetRouter:
                 # or hedge resamples the exact same stream anywhere
                 rng = jax.random.fold_in(self._base_rng, self._seq_no)
             self._seq_no += 1
-            key = self._affinity_key(prompt)
+            key = self._affinity_key_locked(prompt)
             freq = _FleetRequest(prompt, max_new_tokens, deadline_ms, rng,
                                  on_token, key)
             self._outstanding.append(freq)
@@ -255,10 +337,11 @@ class FleetRouter:
     def health(self) -> Dict[str, Any]:
         """Fleet health: per-replica snapshots + aggregate gates."""
         snaps = []
-        for idx, rep in enumerate(self.replicas):
+        for idx, rep in enumerate(self.replicas):  # locked snapshot
             with self._lock:
                 down = idx in self._down
-            snap = {"replica": idx, "routed_down": down}
+                out = idx in self._retired
+            snap = {"replica": idx, "routed_down": down, "retired": out}
             try:
                 snap.update(rep.health())
             except Exception as e:  # a dead replica must not hide the rest
@@ -267,7 +350,8 @@ class FleetRouter:
             snaps.append(snap)
         usable = [
             s for s in snaps
-            if s["ready"] and not s["routed_down"] and not s["heartbeat_stale"]
+            if s["ready"] and not s["routed_down"] and not s["retired"]
+            and not s["heartbeat_stale"]
         ]
         with self._lock:
             outstanding = len(self._outstanding)
@@ -301,24 +385,31 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
     # placement
 
-    def _affinity_key(self, prompt: np.ndarray) -> Optional[Tuple[int, ...]]:
+    def _affinity_key_locked(self, prompt: np.ndarray) -> Optional[Tuple[int, ...]]:
         """The prefix-cache identity of this prompt: its first full KV
         block (kv_pool caches ``(len(prompt)-1)//block_size`` blocks, so
         a prompt contributes/hits the cache iff that is >= 1)."""
         if not self.affinity:
             return None
-        bs = self._block_size()
+        bs = self._block_size_locked()
         if bs is None or (int(prompt.size) - 1) // bs < 1:
             return None
         return tuple(int(t) for t in prompt[:bs])
 
-    def _block_size(self) -> Optional[int]:
-        sched = self._sched_of(0)
+    def _block_size_locked(self) -> Optional[int]:
+        sched = self._sched_of_locked(0)
         return getattr(sched, "_block_size", None) if sched is not None else None
 
     def _sched_of(self, idx: int):
-        """The replica's scheduler (engines wrap one; tests pass it bare)."""
-        rep = self.replicas[idx]
+        """The replica's scheduler, for callers that do NOT hold
+        ``self._lock`` (monitor sweeps, failover, injector consults)."""
+        with self._lock:
+            return self._sched_of_locked(idx)
+
+    def _sched_of_locked(self, idx: int):
+        """The replica's scheduler (engines wrap one; tests pass it bare).
+        Attribute reads only — never calls into the replica."""
+        rep = self._replicas[idx]
         sched = getattr(rep, "scheduler", None)
         if sched is not None:
             return sched
@@ -328,13 +419,14 @@ class FleetRouter:
         """(idx, health snapshot) for every admissible replica.  Calls
         into replicas — never under ``self._lock``."""
         with self._lock:
-            down = set(self._down)
+            unusable = self._down | self._retired
             closed = self._closed
+            reps = list(self._replicas)
         if closed:
             return []
         out = []
-        for idx, rep in enumerate(self.replicas):
-            if idx in down:
+        for idx, rep in enumerate(reps):
+            if idx in unusable:
                 continue
             try:
                 snap = rep.health()
@@ -347,9 +439,12 @@ class FleetRouter:
             out.append((idx, snap))
         return out
 
-    def _load_score(self, idx: int, snap: Dict[str, Any]) -> Tuple[float, float]:
+    def _load_score(self, snap: Dict[str, Any], sched) -> Tuple[float, float]:
+        """Placement key.  The caller resolves ``sched`` with whichever
+        ``_sched_of*`` variant matches its lock context — this helper is
+        reached both under the lock (``_place_locked``) and without it
+        (failover/hedge repair paths)."""
         depth = float(snap.get("queue_depth", 0) + snap.get("active_slots", 0))
-        sched = self._sched_of(idx)
         util = 0.0
         if sched is not None and hasattr(sched, "metrics"):
             util = get_registry().gauge(
@@ -371,7 +466,10 @@ class FleetRouter:
                 self._sticky.move_to_end(key)
                 self._bump_locked("affinity_hits")
                 return cached
-        target = min(healthy, key=lambda h: self._load_score(*h))[0]
+        target = min(
+            healthy,
+            key=lambda h: self._load_score(h[1], self._sched_of_locked(h[0])),
+        )[0]
         if key is not None:
             self._sticky[key] = target
             self._sticky.move_to_end(key)
@@ -391,7 +489,7 @@ class FleetRouter:
             a = _Assignment(idx, len(freq.delivered))
             freq.assignments.append(a)
             replay_tokens = list(freq.delivered) if replay else None
-        rep = self.replicas[idx]
+            rep = self._replicas[idx]
         try:
             fut = rep.submit(
                 freq.prompt,
@@ -550,7 +648,9 @@ class FleetRouter:
         arg = inj.take("replica_down", self._poll_no)
         if arg is not None:
             idx = int(arg)
-            if 0 <= idx < len(self.replicas):
+            with self._lock:
+                known = 0 <= idx < len(self._replicas)
+            if known:
                 fault.bump("injected_replica_downs")
                 self.logger.warning(
                     "fault injection: replica_down -> replica %d at poll %d",
@@ -590,9 +690,11 @@ class FleetRouter:
     def _sweep_health(self) -> None:
         """Mark replicas down on stale heartbeat or dead liveness, and
         strand-rescue their in-flight requests."""
-        for idx, rep in enumerate(self.replicas):
+        for idx, rep in enumerate(self.replicas):  # locked snapshot
             with self._lock:
-                if idx in self._down:
+                # retired replicas drain on their own clock: sweeping
+                # them down would hard-kill the drain mid-request
+                if idx in self._down or idx in self._retired:
                     continue
             stale = self._is_stale(rep)
             dead = False
@@ -609,7 +711,7 @@ class FleetRouter:
 
     def _mark_down(self, idx: int, reason: str) -> None:
         with self._lock:
-            if idx in self._down:
+            if idx in self._down or idx in self._retired:
                 return
             self._down.add(idx)
             victims = []
@@ -650,7 +752,7 @@ class FleetRouter:
         healthy = self._healthy()
         dispatched = False
         for idx, _snap in sorted(
-            healthy, key=lambda h: self._load_score(*h)
+            healthy, key=lambda h: self._load_score(h[1], self._sched_of(h[0]))
         ):
             try:
                 self._dispatch(freq, idx, replay=True)
@@ -696,7 +798,10 @@ class FleetRouter:
         healthy = [(i, s) for i, s in self._healthy() if i not in busy]
         if not healthy:
             return
-        idx = min(healthy, key=lambda h: self._load_score(*h))[0]
+        idx = min(
+            healthy,
+            key=lambda h: self._load_score(h[1], self._sched_of(h[0])),
+        )[0]
         try:
             self._dispatch(freq, idx, replay=True)
         except Exception as e:

@@ -572,6 +572,7 @@ class ContinuousScheduler:
             "stalled": stalled,
             "queue_depth": depth,
             "active_slots": active,
+            "slots": self.slots_n,
             "engine_restarts": restarts,
             "restart_budget": self._supervisor.max_restarts,
             "last_tick_age_s": (now - last) if last is not None else None,

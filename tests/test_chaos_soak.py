@@ -28,6 +28,7 @@ from pytorch_distributed_training_tpu.engine.chaos import (
     ScenarioGenerator,
     coverage_matrix,
     registered_fault_kinds,
+    scaling_cells,
     uncovered_kinds,
 )
 
@@ -88,6 +89,23 @@ def test_every_registered_kind_has_template_coverage():
     assert uncovered_kinds() == []
 
 
+def test_scaling_cells_cover_scale_up_drain_and_decision():
+    """ISSUE 18 acceptance: the coverage matrix gains SCALING-EVENT
+    cells — faults during scale-up, during scale-down drain, and at
+    autoscaler decision time — each populated from the scaling-family
+    templates, so killing a template empties a cell and fails here."""
+    assert "scaling" in FAMILIES
+    cells = scaling_cells()
+    assert set(cells) == {"scale_up", "drain", "decision"}
+    assert "replica_down" in cells["scale_up"]
+    assert set(cells["drain"]) >= {"serve_nan", "serve_raise"}
+    assert cells["decision"] == ["autoscale_hang"]
+    # the decision-time kind is a first-class registered fault, not a
+    # harness hack: it appears in the menu AND the injector grammar
+    assert "autoscale_hang" in FAULT_MENU
+    assert "autoscale_hang" in registered_fault_kinds()
+
+
 def test_uncovered_kinds_detects_a_coverage_gap(monkeypatch):
     """The matrix check is live, not vacuous: registering a new kind in
     fault.py without adding soak coverage is reported."""
@@ -128,6 +146,7 @@ def test_generated_scenarios_compose_and_parse():
 # seeded soak runs
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_soak_smoke_serve_family():
     """Two seeded serve-family scenarios through the REAL continuous
@@ -143,6 +162,25 @@ def test_soak_smoke_serve_family():
     for r in summary["results"]:
         assert r["family"] == "serve"
         assert r["counters"], "scenario fired nothing"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_smoke_scaling_family():
+    """One seeded scaling scenario end to end: the autoscaler grows the
+    fleet into an injected flash crowd, faults land inside the scaling
+    events (per the scenario's phase-tagged template), and scale-down
+    drains with token parity against clean greedy reference streams."""
+    eng = ChaosSoakEngine(seed=3, families=("scaling",))
+    summary = eng.run(1)
+    assert summary["failed"] == 0, [
+        r["failures"] for r in summary["results"] if not r["ok"]
+    ]
+    assert summary["passed"] == 1
+    r = summary["results"][0]
+    assert r["family"] == "scaling"
+    assert r["scale_ups"] >= 1 and r["scale_downs"] >= 1
+    assert r["counters"], "scenario fired nothing"
 
 
 @pytest.mark.slow

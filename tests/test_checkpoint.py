@@ -161,6 +161,7 @@ def _run_hashing(cfg):
     return runner
 
 
+@pytest.mark.slow
 def test_mid_epoch_resume_batch_sequence_bit_exact(tmp_path, one_device_graft):
     """Interrupt at iteration 2 of a 4-batch epoch and resume: the resumed
     run must consume EXACTLY the batches (bitwise) the uninterrupted run
@@ -754,6 +755,7 @@ def test_crash_during_async_write_falls_back_like_truncated_step(tmp_path):
         fault.reset_counters()
 
 
+@pytest.mark.slow
 def test_sidecar_missing_for_committed_step_tolerated(tmp_path, one_device_graft):
     """Satellite regression (sidecar/commit ordering): a checkpoint whose
     sidecar is gone — the old ordering could crash between manager.save and
@@ -774,6 +776,7 @@ def test_sidecar_missing_for_committed_step_tolerated(tmp_path, one_device_graft
     assert resumed.iter == 4  # resumed from step 1 without the sidecar
 
 
+@pytest.mark.slow
 def test_resume_bit_exact_async_vs_straight_run(tmp_path, one_device_graft):
     """The async-save pipeline end to end through the Runner: 4 iters
     straight == 2 iters + async checkpoint + resume 2 more, bit-exact —

@@ -69,6 +69,7 @@ def test_train_step_decreases_loss(sync_bn):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_train_state_stays_replicated():
     mesh, state, train_step, _ = _tiny_setup(sync_bn=True)
     rng = np.random.default_rng(1)

@@ -383,6 +383,7 @@ def _tiny_guarded_step(anomaly_factor, mesh):
     return fresh_state, step, img, label
 
 
+@pytest.mark.slow
 def test_nan_step_skipped_params_bitwise_unchanged(one_device_mesh):
     """anomaly_factor=0.0 arms the non-finite-only check: a NaN batch must
     leave params, momentum and the step counter BITWISE unchanged — nothing
@@ -418,6 +419,7 @@ def test_nan_step_skipped_params_bitwise_unchanged(one_device_mesh):
     assert not np.array_equal(moved, jax.tree.leaves(before_params)[0])
 
 
+@pytest.mark.slow
 def test_gnorm_spike_gated_by_trailing_reference(one_device_mesh):
     """grad_norm_factor > 0: the step is skipped iff the gradient norm
     exceeds factor x the host-fed reference; ref <= 0 means unarmed (the
@@ -499,6 +501,7 @@ def _run(cfg):
     return runner
 
 
+@pytest.mark.slow
 def test_runner_nan_injection_skips_and_continues(tmp_path, one_device_mesh):
     """One injected NaN batch: the step is skipped (counted), training
     continues to completion, and the final params are finite."""
@@ -518,6 +521,7 @@ def test_runner_nan_injection_skips_and_continues(tmp_path, one_device_mesh):
     assert int(runner.state.step) == 2
 
 
+@pytest.mark.slow
 def test_runner_consecutive_anomalies_rollback_and_resume(tmp_path, one_device_mesh):
     """max_consecutive NaN steps trip the rollback: the Runner restores the
     last checkpoint, rebuilds the input stream, and completes the run."""
@@ -539,6 +543,7 @@ def test_runner_consecutive_anomalies_rollback_and_resume(tmp_path, one_device_m
         assert np.isfinite(leaf).all()
 
 
+@pytest.mark.slow
 def test_rollback_flushes_async_writer_before_restore(tmp_path, one_device_mesh,
                                                       monkeypatch):
     """Async checkpointing composes with the anomaly-guard rollback: the
@@ -584,6 +589,7 @@ def test_rollback_flushes_async_writer_before_restore(tmp_path, one_device_mesh,
     ), f"no drain(raise_errors=False) directly before restore_latest: {calls}"
 
 
+@pytest.mark.slow
 def test_runner_rollback_without_checkpoint_is_loud(tmp_path, one_device_mesh):
     """Anomaly burst with no checkpoint configured: a descriptive error,
     not a silent loop."""
@@ -596,6 +602,7 @@ def test_runner_rollback_without_checkpoint_is_loud(tmp_path, one_device_mesh):
         _run(cfg)
 
 
+@pytest.mark.slow
 def test_ckpt_save_failures_retried_final_state_matches(tmp_path, one_device_mesh):
     """Injected checkpoint-save failures are absorbed by the retry policy:
     training completes and the final params BIT-match an uninjected run
@@ -869,6 +876,7 @@ def test_emergency_save_bounded_when_async_write_wedged(tmp_path, monkeypatch):
     ck.close()
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_sdc_during_rollback_replay_restores_post_rollback_timeline(
     tmp_path, one_device_mesh
@@ -911,6 +919,7 @@ def test_sdc_during_rollback_replay_restores_post_rollback_timeline(
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 @pytest.mark.chaos
 def test_watchdog_reenters_warmup_after_rollback(tmp_path, one_device_mesh):
     """Compound #4: the hung-step watchdog's trailing median survives a
@@ -1052,6 +1061,7 @@ def test_preemption_guard_inert_off_main_thread():
     assert signal.getsignal(signal.SIGTERM) is before  # untouched
 
 
+@pytest.mark.slow
 def test_runner_parses_preemption_signals_from_yaml(tmp_path, one_device_mesh):
     """training.checkpoint.preemption_signals reaches the installed guard."""
     cfg = _ft_cfg(tmp_path, train_iters=2, ckpt=True)

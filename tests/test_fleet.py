@@ -524,3 +524,66 @@ def test_metrics_namespacing_and_fleet_aggregate(lm_and_params):
     c = fault.counters()
     assert c.get("serving_r0_retired", 0) >= 1
     assert c.get("serving_r1_retired", 0) >= 1
+
+
+# --------------------------------------------------------------------- #
+# elastic scale-down: drain-preserved parity (ISSUE 18 tentpole oracle)
+
+
+def test_scale_down_drains_in_flight_requests_token_identical(lm_and_params):
+    """Retiring a replica mid-stream (the autoscaler's scale-down path)
+    completes its in-flight requests with token streams bitwise equal to
+    an unscaled twin: retirement only removes the replica from
+    placement — nothing is killed, failed over, or replayed."""
+    model, params = lm_and_params
+    prompts = _prompts(seed=23)
+    base = jax.random.PRNGKey(31)
+    fault.reset_counters()
+    expected = _twin_streams(model, params, prompts, base)
+
+    fault.reset_counters()
+    r0 = _mk_replica(model, params, 0)
+    r1 = _mk_replica(model, params, 1)
+    router = _mk_router([r0, r1], base)
+    streams = {i: [] for i in range(len(prompts))}
+    futs = [
+        router.submit(p, on_token=lambda t, i=i: streams[i].append(int(t)))
+        for i, p in enumerate(prompts)
+    ]
+    placed = _placements(router)
+    assert {idx for a in placed.values() for idx in a} == {0, 1}
+    on_retiree = [
+        i for i, a in placed.items() if any(idx == 1 for idx in a)
+    ]
+
+    for _ in range(3):  # mid-stream on both replicas
+        r0.tick()
+        r1.tick()
+    assert all(0 < len(s) < len(expected[i]) for i, s in streams.items())
+
+    router.retire_replica(1)
+    assert router.live_indices() == [0]
+    # new work no longer lands on the retiree...
+    tail = router.submit(prompts[0])
+    assert all(
+        a.replica_idx == 0
+        for a in router._outstanding[-1].assignments
+    )
+    # ...while its in-flight requests keep ticking to completion (what
+    # fleet.remove_replica's drain step does, hand-driven here)
+    _drive([r0, r1], futs + [tail])
+
+    results = [list(map(int, f.result()["tokens"])) for f in futs]
+    router.shutdown()
+    r1.close()
+    r0.close()
+    assert on_retiree, "placement never used the retiree; oracle is vacuous"
+    assert results == expected
+    assert [streams[i] for i in range(len(prompts))] == expected
+    c = fault.counters()
+    assert c.get("serving_fleet_replicas_retired") == 1
+    # drain is not death: no failover, no replay, no parity repair ran
+    assert c.get("serving_fleet_failovers", 0) == 0
+    assert c.get("serving_fleet_replicas_down", 0) == 0
+    assert c.get("serving_fleet_parity_mismatch", 0) == 0
+    assert c.get("replay_parity_mismatch", 0) == 0

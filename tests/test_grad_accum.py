@@ -192,6 +192,7 @@ def test_tp_gspmd_accum_matches_plain():
         np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gspmd_accum_zero_and_moe_match_plain():
     """The guard removal also enabled ZeRO and MoE accumulation — pin both:
     ZeRO's data-sharded moments and MoE's routing must be invariant to the

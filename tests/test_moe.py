@@ -200,6 +200,7 @@ def test_moe_block_pattern_and_specs():
     assert specs["block0"]["mlp"]["fc1"]["kernel"] == P(None, "model")
 
 
+@pytest.mark.slow
 def test_moe_ep_step_matches_single_device():
     """DP(2) x EP(4): one GSPMD train step on the 8-device mesh == the
     single-device step (loss AND updated params), with the aux loss in the

@@ -121,6 +121,7 @@ def _single_device_step(lm, opt):
     return step
 
 
+@pytest.mark.slow
 def test_decompose_buckets_partition_step_time():
     """Bucket contract: non-negative, fixed key set, and the published
     buckets sum to step_ms within 10% (by construction they partition it

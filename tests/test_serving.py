@@ -40,6 +40,7 @@ def lm_and_params():
 # decode parity
 
 
+@pytest.mark.slow
 def test_decode_parity_incremental_matches_full(lm_and_params):
     model, params = lm_and_params
     toks = jax.random.randint(jax.random.PRNGKey(1), (3, 12), 0, VOCAB)
@@ -106,6 +107,7 @@ def test_decode_parity_ragged_prompt_lengths(lm_and_params):
         )
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_manual_argmax(lm_and_params):
     """build_generate_fn's loop = repeated full-forward argmax continuation."""
     model, params = lm_and_params
@@ -1015,6 +1017,7 @@ def test_quant_decode_greedy_drift_bound_and_compile_pin(
     assert sched.compile_count() == base_compiles
 
 
+@pytest.mark.slow
 def test_lora_multiplexed_parity_with_merged_engine(
     lm_and_params, mode_prompts, plain_sched_results
 ):

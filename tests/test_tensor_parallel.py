@@ -224,6 +224,7 @@ def test_zero1_sharded_moments_match_plain():
 
 
 @pytest.mark.quick
+@pytest.mark.slow
 def test_zero2_sharded_grads_match_plain():
     """training.zero: 2 (ZeRO-2): gradient buffers constrained to the
     data-sharded layout must yield EXACTLY the plain-DP step — with and

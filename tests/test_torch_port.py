@@ -126,6 +126,7 @@ def _randomize_running_stats(model: tnn.Module, seed: int) -> None:
 
 @pytest.mark.parametrize("name", ["ResNet18", "ResNet50"])
 @pytest.mark.quick
+@pytest.mark.slow
 def test_eval_logits_match_torch(name):
     num_classes = 10  # full topology, small head: cheaper, equally strict
     block, layers = _TORCH_CONFIGS[name]

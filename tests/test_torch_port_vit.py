@@ -145,6 +145,7 @@ def test_vit_port_strictness():
         import_torch_vit_state_dict(variables, wrong, num_heads=HEADS)
 
 
+@pytest.mark.slow
 def test_vit_pretrained_config(tmp_path):
     """model.pretrained covers the ViT family through the Runner: the
     config-initialized state reproduces the twin's eval logits."""

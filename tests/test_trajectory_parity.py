@@ -181,6 +181,7 @@ def test_training_trajectory_matches_torch():
     ],
     ids=["no-momentum", "no-lr-drop"],
 )
+@pytest.mark.slow
 def test_trajectory_canary_catches_wrong_recipe(wrong):
     """The tolerance tiers have teeth: a deliberately wrong recipe run
     through the same harness must violate the bounds the real recipe
