@@ -1998,6 +1998,246 @@ def bench_autoscale():
     _persist_serve_artifact(record)
 
 
+def bench_disagg():
+    """Disagg A/B: prefill/decode disaggregation vs a colocated fleet.
+
+    The same prefill-heavy shared-prefix workload (G groups, long shared
+    prompt prefixes, short generations — the shape where prompt compute
+    crowds decode slots) runs twice at the same DECODE replica count:
+    once through a :class:`DisaggFleet` (dedicated prefill replicas +
+    fleet-shared KV cache directory, blocks transferred instead of
+    recomputed), once through the plain colocated :class:`ServingFleet`.
+    Honest framing: the disagg arm spends extra compute on its prefill
+    replicas — the claim under test is decode-tail isolation at equal
+    decode capacity, not equal total capacity.
+
+    Latency split per request: TTFT is stamped by the first ``on_token``
+    callback; decode tail = completion - TTFT.  The headline is decode
+    p99 (the metric prefill interference pollutes); TTFT and transfer /
+    fleet-cache counters ride along in the JSON line.
+
+      BENCH_DISAGG_REPLICAS    decode replicas in BOTH arms (default 2)
+      BENCH_DISAGG_PREFILL     prefill replicas, disagg arm (default 1)
+      BENCH_DISAGG_GROUPS      prefix groups (default 8)
+      BENCH_DISAGG_GROUP_SIZE  requests per group (default 8)
+      BENCH_DISAGG_PREFIX_LEN  shared-prefix tokens per group (default 12)
+    """
+    import copy
+
+    import numpy as np
+
+    from pytorch_distributed_training_tpu.config_parsing import get_serve_cfg
+    from pytorch_distributed_training_tpu.engine import fault
+    from pytorch_distributed_training_tpu.serving import (
+        DisaggFleet,
+        ServingFleet,
+    )
+
+    n_replicas = int(os.environ.get("BENCH_DISAGG_REPLICAS", "2"))
+    n_prefill = int(os.environ.get("BENCH_DISAGG_PREFILL", "1"))
+    n_groups = int(os.environ.get("BENCH_DISAGG_GROUPS", "8"))
+    group_size = int(os.environ.get("BENCH_DISAGG_GROUP_SIZE", "8"))
+    prefix_len = int(os.environ.get("BENCH_DISAGG_PREFIX_LEN", "12"))
+    base_cfg = get_serve_cfg(
+        os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+    )
+    base_cfg["serving"]["scheduler"] = {
+        "enabled": True, "slots": 4, "block_size": 4, "num_blocks": 64,
+        "prefix_cache": True,
+    }
+    base_cfg["serving"]["fleet"] = {
+        "replicas": n_replicas,
+        "affinity": True,
+        "heartbeat_timeout_s": 30.0,
+        "poll_interval_s": 0.05,
+    }
+    base_cfg["serving"]["disagg"] = {
+        "enabled": True,
+        "prefill_replicas": n_prefill,
+        "transfer_deadline_ms": 2000.0,
+        "transfer_workers": 2,
+    }
+    vocab = base_cfg["dataset"]["n_classes"]
+    rng = np.random.default_rng(7)
+    seq_max = max(int(s) for s in base_cfg["serving"]["seq_buckets"])
+    prefix_len = min(prefix_len, seq_max - 1)
+    suffix_len = min(4, max(1, seq_max - prefix_len))
+    prompts = []
+    for g in range(n_groups):
+        shared = rng.integers(2, vocab, prefix_len).astype(np.int32)
+        for _ in range(group_size):
+            suffix = rng.integers(2, vocab, suffix_len).astype(np.int32)
+            prompts.append(np.concatenate([shared, suffix]))
+    order = rng.permutation(len(prompts))  # interleave the groups
+
+    def drive(submit, warm_replicas):
+        warm = rng.integers(2, vocab, seq_max // 2).astype(np.int32)
+        for rep in warm_replicas:  # compile outside the measured window
+            rep.submit(warm).result(timeout=600)
+        ttft = {}
+        total = {}
+        futures = []
+        t_start = time.perf_counter()
+        for k in order:
+            t0 = time.perf_counter()
+
+            def first_token(_tok, t0=t0, k=int(k)):
+                if k not in ttft:
+                    ttft[k] = (time.perf_counter() - t0) * 1000.0
+
+            fut = submit(prompts[k], first_token)
+            fut.add_done_callback(
+                lambda f, t0=t0, k=int(k): total.__setitem__(
+                    k, (time.perf_counter() - t0) * 1000.0
+                )
+            )
+            futures.append(fut)
+        for fut in futures:
+            fut.result(timeout=600)
+        wall_s = time.perf_counter() - t_start
+        decode = np.array(
+            sorted(total[k] - ttft.get(k, 0.0) for k in total)
+        )
+        ttft_v = np.array(sorted(ttft.values())) if ttft else np.zeros(1)
+        return {
+            "decode_p50": float(np.percentile(decode, 50)),
+            "decode_p99": float(np.percentile(decode, 99)),
+            "ttft_p50": float(np.percentile(ttft_v, 50)),
+            "ttft_p99": float(np.percentile(ttft_v, 99)),
+            "reqs_per_sec": len(prompts) / wall_s,
+        }
+
+    # arm A: disaggregated (prefill replicas + fleet-shared KV tier)
+    fault.reset_counters()
+    disagg = DisaggFleet.from_config(copy.deepcopy(base_cfg))
+    try:
+        a = drive(
+            lambda p, cb: disagg.submit(p, on_token=cb),
+            disagg.fleet.replicas + disagg.prefill_replicas,
+        )
+        counters = dict(fault.counters())
+        a["fleet_cache_hits"] = counters.get("serving_fleet_cache_hits", 0)
+        a["fleet_cache_misses"] = counters.get("serving_fleet_cache_misses", 0)
+        a["fleet_cache_rejects"] = counters.get("serving_fleet_cache_rejects", 0)
+        a["transfers"] = counters.get("serving_disagg_transfers", 0)
+        a["transfer_recomputes"] = counters.get(
+            "serving_disagg_transfer_recomputes", 0
+        )
+        a["kv_transfer_bytes"] = sum(
+            v for k, v in counters.items() if k.endswith("kv_transfer_bytes")
+        )
+        looked = a["fleet_cache_hits"] + a["fleet_cache_misses"]
+        a["fleet_cache_hit_rate"] = round(
+            a["fleet_cache_hits"] / looked if looked else 0.0, 3
+        )
+    finally:
+        disagg.close()
+
+    # arm B: colocated — same decode replica count, no prefill tier
+    fault.reset_counters()
+    cfg_b = copy.deepcopy(base_cfg)
+    del cfg_b["serving"]["disagg"]
+    fleet = ServingFleet.from_config(cfg_b)
+    try:
+        b = drive(
+            lambda p, cb: fleet.submit(p, on_token=cb), fleet.replicas
+        )
+    finally:
+        fleet.close()
+
+    print(
+        json.dumps(
+            {
+                "metric": f"disagg decode p99 vs colocated fleet "
+                f"({n_groups}x{group_size} prefill-heavy shared-prefix "
+                f"reqs, {n_replicas} decode + {n_prefill} prefill)",
+                "value": round(a["decode_p99"], 2),
+                "unit": "ms",
+                "vs_baseline": round(b["decode_p99"], 2),
+                "disagg": {k: round(v, 3) if isinstance(v, float) else v
+                           for k, v in a.items()},
+                "colocated": {k: round(v, 3) if isinstance(v, float) else v
+                              for k, v in b.items()},
+                "decode_p99_ratio": (
+                    round(a["decode_p99"] / b["decode_p99"], 3)
+                    if b["decode_p99"] else None
+                ),
+            }
+        )
+    )
+    art = _persist_serve_artifact({
+        "mode": "disagg",
+        "metric": "disagg decode p99 vs colocated fleet",
+        "value": round(a["decode_p99"], 2),
+        "unit": "ms",
+        "vs_baseline": round(b["decode_p99"], 2),
+        "disagg": a,
+        "colocated": b,
+    })
+    if art:
+        print(f"bench round recorded: {art}", file=sys.stderr)
+
+
+def bench_chaos_disagg():
+    """Chaos-disagg: seeded fault scenarios on the KV-transfer edge.
+
+    Thin driver over :class:`ChaosSoakEngine` restricted to the
+    ``disagg`` family: prefill death mid-transfer, corrupt payloads,
+    stalls past the transfer deadline, and decode death mid-handoff,
+    each judged by the soak oracles — every request completes, token
+    streams bitwise-match an uninjected twin, every fired fault is
+    attributed to exactly one recovery rung, KV pools keep their
+    invariants, and no owned thread leaks.
+
+      BENCH_CHAOS_DISAGG_SEED       scenario-schedule seed (default 42)
+      BENCH_CHAOS_DISAGG_SCENARIOS  scenario count (default 4)
+
+    Exit status mirrors bench_soak: 0 all green, 1 any scenario red.
+    """
+    from pytorch_distributed_training_tpu.engine.chaos import ChaosSoakEngine
+
+    seed = int(os.environ.get("BENCH_CHAOS_DISAGG_SEED", "42"))
+    n = int(os.environ.get("BENCH_CHAOS_DISAGG_SCENARIOS", "4"))
+    eng = ChaosSoakEngine(seed=seed, families=("disagg",))
+    t0 = time.monotonic()
+    summary = eng.run(n)
+    compact = [
+        {
+            k: r[k]
+            for k in (
+                "index", "family", "overlap", "spec", "ok", "failures",
+                "parity", "duration_s",
+            )
+            if k in r
+        }
+        for r in summary["results"]
+    ]
+    record = {
+        "metric": f"chaos-disagg: {n} seeded KV-transfer fault scenarios "
+        "(oracle-judged), scenarios passed",
+        "value": summary["passed"],
+        "unit": "scenarios",
+        "seed": summary["seed"],
+        "failed": summary["failed"],
+        "kinds_exercised": summary["kinds_exercised"],
+        "results": compact,
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    print(json.dumps(record))
+    art = _persist_serve_artifact({"mode": "chaos-disagg", **record})
+    if art:
+        print(f"bench round recorded: {art}", file=sys.stderr)
+    if summary["failed"]:
+        for r in summary["results"]:
+            if not r["ok"]:
+                print(
+                    f"CHAOS-DISAGG RED scenario {r['index']} {r['spec']}: "
+                    f"{r['failures']}",
+                    file=sys.stderr,
+                )
+        sys.exit(1)
+
+
 def bench_chaos():
     """Chaos mode: the smoke run under a standard fault script, end to end.
 
@@ -2720,7 +2960,8 @@ if __name__ == "__main__":
     if mode not in (
         "chaos", "--chaos", "chaos-serve", "--chaos-serve",
         "chaos-integrity", "--chaos-integrity",
-        "chaos-fleet", "--chaos-fleet", "soak", "--soak", "lint"
+        "chaos-fleet", "--chaos-fleet", "chaos-disagg", "--chaos-disagg",
+        "soak", "--soak", "lint"
     ) or os.environ.get("BENCH_COMPILE_CACHE"):
         _enable_compile_cache()
     if mode == "lint":
@@ -2759,6 +3000,10 @@ if __name__ == "__main__":
         bench_fleet_serve()
     elif mode in ("autoscale", "--autoscale"):
         bench_autoscale()
+    elif mode in ("disagg", "--disagg"):
+        bench_disagg()
+    elif mode in ("chaos-disagg", "--chaos-disagg"):
+        bench_chaos_disagg()
     elif mode == "accuracy":
         # Converged-accuracy parity (round-3 VERDICT #1): train ResNet-18
         # through this framework's compiled step AND through a torch

@@ -66,6 +66,7 @@ _SEED_PARAMS = {
     "speculative": ("serving", "speculative"),
     "autoscale": ("serving", "autoscale"),
     "workload": ("serving", "autoscale", "workload"),
+    "disagg": ("serving", "disagg"),
 }
 _ACCESS_METHODS = {"get", "pop", "setdefault"}
 _CASTS = {"int", "float", "bool", "str"}

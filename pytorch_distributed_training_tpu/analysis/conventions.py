@@ -59,6 +59,8 @@ BENCH_DRIVERS = (
     "bench_soak(",
     "bench_serve_modes(",
     "bench_autoscale(",
+    "bench_disagg(",
+    "bench_chaos_disagg(",
 )
 
 FAULT_MACHINERY = (

@@ -78,11 +78,12 @@ __all__ = [
     "Scenario",
     "ScenarioGenerator",
     "coverage_matrix",
+    "disagg_cells",
     "scaling_cells",
     "uncovered_kinds",
 ]
 
-FAMILIES = ("train", "serve", "elastic", "fleet", "scaling")
+FAMILIES = ("train", "serve", "elastic", "fleet", "scaling", "disagg")
 
 OVERLAP_MODES = ("sequential", "adjacent", "concurrent")
 
@@ -138,6 +139,13 @@ FAULT_MENU: Dict[str, FaultKind] = {
                   ("injected_replica_hangs",), parity=True),
         FaultKind("autoscale_hang", "scaling", "decision_reread_after_hang",
                   ("injected_autoscale_hangs",), parity=True),
+        FaultKind("kv_transfer_stall", "disagg", "transfer_deadline_degrade",
+                  ("serving_disagg_deadline_degrades",), parity=True),
+        FaultKind("kv_transfer_corrupt", "disagg", "checksum_reject_recompute",
+                  ("serving_disagg_rejects",), parity=True),
+        FaultKind("prefill_replica_down", "disagg",
+                  "prefill_death_local_recompute",
+                  ("serving_disagg_transfer_recomputes",), parity=True),
     )
 }
 
@@ -185,6 +193,21 @@ def scaling_cells() -> Dict[str, List[str]]:
         for atom in template:
             phase, _, kind = atom.partition(":")
             cells[phase_of[phase]].add(kind)
+    return {k: sorted(v) for k, v in cells.items()}
+
+
+def disagg_cells() -> Dict[str, List[str]]:
+    """``disaggregation phase -> fault kinds`` the scenario space can
+    land in that window.  ``transfer`` covers the KV-transfer edge
+    (stall past deadline, corrupt payload, prefill death mid-export);
+    ``handoff`` covers decode death while a just-staged request is being
+    handed to its replica.  Pinned non-empty by tier-1 so disagg
+    coverage cannot silently regress."""
+    cells: Dict[str, set] = {"transfer": set(), "handoff": set()}
+    for template in _TEMPLATES["disagg"]:
+        for atom in template:
+            phase, _, kind = atom.partition(":")
+            cells[phase].add(kind)
     return {k: sorted(v) for k, v in cells.items()}
 
 
@@ -269,6 +292,17 @@ _TEMPLATES: Dict[str, Tuple[Tuple[str, ...], ...]] = {
         ("drain:serve_nan", "decision:autoscale_hang"),
         ("up:replica_down", "drain:serve_raise"),
         ("drain:serve_raise", "decision:autoscale_hang"),
+    ),
+    # disagg atoms are "<phase>:<kind>": transfer-phase kinds key on the
+    # coordinator's 1-based KV-transfer ordinal; the handoff-phase
+    # replica_down keys on the router poll clock exactly as in the fleet
+    # family (decode death while staged requests are in flight)
+    "disagg": (
+        ("transfer:kv_transfer_corrupt", "transfer:kv_transfer_stall"),
+        ("transfer:prefill_replica_down", "transfer:kv_transfer_corrupt"),
+        ("transfer:kv_transfer_stall", "handoff:replica_down"),
+        ("transfer:prefill_replica_down", "transfer:kv_transfer_stall",
+         "transfer:kv_transfer_corrupt"),
     ),
 }
 
@@ -464,6 +498,39 @@ class ScenarioGenerator:
                 entries.append(FaultEntry(kind, rng.randint(1, 2), "0"))
         return entries
 
+    def _place_disagg(self, rng: Random, template: Tuple[str, ...],
+                      overlap: str) -> List[FaultEntry]:
+        """Transfer-phase entries key on the coordinator's 1-based
+        transfer ordinal; the handoff replica_down keys on router polls.
+
+        Ordinals are assigned deterministically: _run_disagg serializes
+        transfers (one worker, single-flight, distinct prefix groups) so
+        ordinal K is exactly the Kth staged request.  prefill_replica_
+        down is pinned to ordinal 1 — the directory starts empty, so the
+        first transfer is always prefill-sourced (later ordinals may be
+        replica-to-replica, where no prefill is in the path and the
+        fault would go unfired)."""
+        del overlap  # the ordinal clock imposes the temporal structure
+        entries = []
+        next_ord = 2  # ordinal 1 is reserved for prefill_replica_down
+        for atom in template:
+            _, _, kind = atom.partition(":")
+            if kind == "prefill_replica_down":
+                entries.append(FaultEntry(kind, 1, "0"))
+            elif kind == "kv_transfer_stall":
+                # decisively past the 800 ms transfer deadline the
+                # runner configures, far below any request deadline
+                entries.append(FaultEntry(
+                    kind, next_ord, f"{rng.uniform(1.5, 2.0):.2f}"
+                ))
+                next_ord += 1
+            elif kind == "kv_transfer_corrupt":
+                entries.append(FaultEntry(kind, next_ord))
+                next_ord += 1
+            else:  # handoff:replica_down — decode death, poll-keyed
+                entries.append(FaultEntry(kind, rng.randint(2, 4), "0"))
+        return entries
+
     # ------------------------------------------------------------ generation
     def generate(self, n: int) -> List[Scenario]:
         """``n`` scenarios, round-robin over the configured families.
@@ -480,6 +547,7 @@ class ScenarioGenerator:
             "elastic": self._place_elastic,
             "fleet": self._place_fleet,
             "scaling": self._place_scaling,
+            "disagg": self._place_disagg,
         }
         out: List[Scenario] = []
         for i in range(n):
@@ -550,7 +618,7 @@ class ChaosSoakEngine:
     # workers across runs and are not a lifecycle leak
     _OWNED_THREAD_PREFIXES = (
         "serving-", "ckpt-async-writer", "step-watchdog", "fleet-",
-        "elastic-", "router-", "heartbeat",
+        "elastic-", "router-", "heartbeat", "disagg-",
     )
 
     @staticmethod
@@ -1396,6 +1464,148 @@ class ChaosSoakEngine:
         if leaked:
             failures.append(f"leaked threads: {leaked}")
 
+    # --------------------------------------------------------------- disagg
+    def _run_disagg(self, scn: Scenario, result: Dict,
+                    failures: List[str]) -> None:
+        """Faults on the prefill/decode disaggregation transfer edge.
+
+        A 2-replica decode fleet behind a :class:`DisaggFleet` with 2
+        prefill replicas serves 2 rounds x 4 prefix groups (same first
+        block per group, fresh suffix per round).  One transfer worker +
+        single-flight staging serialize the coordinator, so KV-transfer
+        ordinal K is exactly the Kth staged request and _place_disagg's
+        ordinal-keyed faults land deterministically: round 1 walks
+        ordinals 1-4 (all prefill-sourced — the directory starts empty),
+        round 2 re-transfers only the groups whose round-1 transfer
+        degraded.
+
+        Oracles: every armed fault fires; all 8 streams match the
+        uninjected twin bit-for-bit (a transferred block that differed
+        from local recompute would break parity by construction); each
+        kind's recovery rung moved its FAULT_MENU counter; live KV pools
+        hold their invariants; no owned thread outlives close.
+        """
+        import copy
+
+        import numpy as np
+
+        from ..config_parsing import get_serve_cfg
+        from ..serving.disagg import DisaggFleet
+
+        base = get_serve_cfg(
+            os.environ.get("BENCH_SERVE_CONFIG", "config/serve-lm.yml")
+        )
+        base["serving"]["scheduler"] = {
+            "enabled": True, "slots": 4, "block_size": 4, "num_blocks": 64,
+            "prefix_cache": True,
+        }
+        base["serving"]["resilience"] = {
+            "max_restarts": 3, "poison_bisect": True,
+            "drain_deadline_ms": 60_000,
+        }
+        base["serving"]["fleet"] = {
+            "replicas": 2, "affinity": True,
+            "heartbeat_timeout_s": 30.0, "poll_interval_s": 0.02,
+        }
+        # deadline sits above the first import's one-off scatter compile
+        # (~100 ms) and decisively below _place_disagg's 1.5-2.0 s stall;
+        # 2 prefill replicas so a prefill kill at ordinal 1 leaves
+        # capacity for the later ordinals' faults to reach
+        base["serving"]["disagg"] = {
+            "enabled": True, "prefill_replicas": 2,
+            "transfer_deadline_ms": 800.0, "transfer_workers": 1,
+        }
+
+        def run_disagg(inject: bool):
+            cfg = copy.deepcopy(base)
+            cfg["serving"]["temperature"] = 0.0
+            rng = np.random.default_rng(0)
+            vocab = cfg["dataset"]["n_classes"]
+            fault.reset_counters()
+            fleet = DisaggFleet.from_config(cfg)
+            try:
+                seq_max = fleet.fleet.replicas[0].seq_buckets[-1]
+                warm_reps = fleet.fleet.replicas + fleet.prefill_replicas
+                for rep in warm_reps:  # compile outside the chaos window
+                    rep.submit(
+                        rng.integers(2, vocab, seq_max // 2).astype(np.int32)
+                    ).result(timeout=600)
+                # 4 prefix groups: fixed first block, variable suffix
+                blocks = [
+                    rng.integers(2, vocab, 4).astype(np.int32)
+                    for _ in range(4)
+                ]
+                if inject:
+                    # transfer ordinals count coordinator transfers from
+                    # NOW (the direct warms above bypassed it — clock at
+                    # 0); only the handoff replica_down rides the router
+                    # poll clock and shifts past the warmup's polls
+                    poll0 = fleet.router._poll_no
+                    shifted = ";".join(
+                        FaultEntry(
+                            e.kind,
+                            e.step + (
+                                poll0 if e.kind == "replica_down" else 0
+                            ),
+                            e.arg,
+                        ).render()
+                        for e in scn.entries
+                    )
+                    fault.install(shifted)
+                mnt = min(4, fleet.fleet.replicas[0].max_new_tokens)
+                streams = []
+                for _round in range(2):
+                    futures = []
+                    for blk in blocks:
+                        ln = int(rng.integers(1, seq_max - 4 + 1))
+                        prompt = np.concatenate(
+                            [blk, rng.integers(2, vocab, ln).astype(np.int32)]
+                        )
+                        futures.append(
+                            fleet.submit(prompt, max_new_tokens=mnt)
+                        )
+                    # round barrier: every stage preceded its submit on
+                    # the single worker, so round 2 sees round 1's
+                    # directory outcome, not a half-staged one
+                    streams.extend(
+                        tuple(int(t) for t in f.result(timeout=600)["tokens"])
+                        for f in futures
+                    )
+                pend = fault.get_injector().pending()
+                for rep in warm_reps:
+                    sched = rep.scheduler
+                    if not (sched._closed or sched._dead):
+                        sched._kv.check_invariants()
+                return streams, dict(fault.counters()), pend
+            finally:
+                fault.install(None)
+                fleet.close()
+
+        baseline = self._thread_baseline()
+        twin_key = ("disagg",)
+        if twin_key not in self._twins:
+            streams, _, _ = run_disagg(inject=False)
+            self._twins[twin_key] = {"results": streams}
+        twin = self._twins[twin_key]
+        streams, counters, pend = run_disagg(inject=True)
+        result["counters"] = {k: v for k, v in counters.items() if v}
+        if pend:
+            failures.append(f"faults never fired: {pend}")
+        if streams != twin["results"]:
+            failures.append(
+                "disagg token streams diverged from uninjected twin"
+            )
+        result["parity"] = streams == twin["results"]
+        for kind in scn.kinds():
+            menu = FAULT_MENU[kind]
+            if not any(counters.get(c, 0) > 0 for c in menu.counters):
+                failures.append(
+                    f"{kind}: no recovery attribution in disagg counters"
+                )
+        leaked = self._leaked_threads(baseline)
+        if leaked:
+            failures.append(f"leaked threads: {leaked}")
+
     # ------------------------------------------------------------------ run
     def run_scenario(self, scn: Scenario) -> Dict:
         t0 = time.monotonic()
@@ -1412,6 +1622,7 @@ class ChaosSoakEngine:
             "elastic": self._run_elastic,
             "fleet": self._run_fleet,
             "scaling": self._run_scaling,
+            "disagg": self._run_disagg,
         }[scn.family]
         try:
             runner(scn, result, failures)

@@ -97,9 +97,29 @@ serving/autoscaler.py consults the injector once per control-loop poll):
                        that signals are re-read fresh AFTER the hang, so
                        a stale pre-hang view never drives a scale action
 
+Disaggregation-level kinds (N = the disagg coordinator's KV TRANSFER
+ordinal, 1-based — serving/disagg.py consults the injector once per
+transfer attempt):
+
+    kv_transfer_stall@N[:SEC]
+                       sleep SEC (default 1.0) inside transfer N's
+                       export on the source scheduler — the
+                       coordinator's bounded deadline must trip and
+                       degrade the request to the colocated path
+    kv_transfer_corrupt@N
+                       flip one byte of transfer N's first block payload
+                       after its CRC-32 manifest is computed — the
+                       importing scheduler must reject the block and the
+                       request recomputes the suffix locally
+    prefill_replica_down@N[:R]
+                       hard-kill prefill replica R (default 0) as
+                       transfer N begins, so the in-flight export dies —
+                       the decode side must recompute locally and the
+                       request never fails
+
 Step-keyed faults (``nan_batch``/``kill_worker``/``stall_step``/
-``sdc_flip``/``ckpt_corrupt``/the ``serve_*`` and ``replica_*``
-families) are one-shot:
+``sdc_flip``/``ckpt_corrupt``/the ``serve_*``, ``replica_*``, and
+``kv_transfer_*``/``prefill_*`` families) are one-shot:
 consumed when they fire, so a rollback replay of the same step index does
 not re-trip them (the recovery itself must converge).
 
@@ -140,6 +160,7 @@ _STEP_KINDS = (
     "sdc_flip", "ckpt_corrupt",
     "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
     "replica_down", "replica_hang", "autoscale_hang",
+    "kv_transfer_stall", "kv_transfer_corrupt", "prefill_replica_down",
 )
 _POINT_KINDS = {
     "ckpt_fail": "ckpt_save",
@@ -210,18 +231,20 @@ class FaultInjector:
         elif kind in _STEP_KINDS:
             if kind in (
                 "kill_worker", "serve_nan", "serve_raise", "sdc_flip",
-                "replica_down",
+                "replica_down", "prefill_replica_down",
             ):
                 # arg = worker index / scheduler slot index / replica rank
-                # / fleet replica index (default 0)
+                # / fleet replica index / prefill replica index (default 0)
                 val = float(int(arg)) if arg is not None else 0.0
             elif kind == "kill_peer":
                 # arg = target process index; -1 = whichever rank parses it
                 val = float(int(arg)) if arg is not None else -1.0
             elif kind in ("stall_step", "serve_hang", "replica_hang",
-                          "autoscale_hang"):
+                          "autoscale_hang", "kv_transfer_stall"):
                 val = float(arg) if arg is not None else 1.0
-            else:  # nan_batch / serve_device_lost / ckpt_corrupt take no arg
+            else:
+                # nan_batch / serve_device_lost / ckpt_corrupt /
+                # kv_transfer_corrupt take no arg
                 if arg is not None:
                     raise ValueError(
                         f"bad {ENV_VAR} entry {entry!r}: {kind} takes no arg"

@@ -27,6 +27,11 @@ lever — saturate the accelerator by batching — to inference:
     flash-crowd request traces (pure function of the seed).
   - :mod:`.autoscaler` — :class:`FleetAutoscaler`: SLO-driven replica
     scaling; grows via the shared restore, shrinks only through drain.
+  - :mod:`.kv_transfer` — content-addressed, CRC-32-verified paged-KV
+    block export/import between replicas (host-staged).
+  - :mod:`.disagg`   — :class:`DisaggFleet`: prefill/decode
+    disaggregation over a :class:`FleetCacheDirectory` fleet-shared
+    prefix-cache tier, with a degrade-to-colocated recovery ladder.
 
 ``python -m pytorch_distributed_training_tpu.serving --config
 config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
@@ -34,9 +39,11 @@ config/serve-lm.yml`` runs a synthetic open-loop demo (``__main__``).
 from .autoscaler import FleetAutoscaler
 from .batcher import DynamicBatcher
 from .decode import build_generate_fn, build_paged_fns
+from .disagg import DisaggFleet, FleetCacheDirectory
 from .engine import InferenceEngine
 from .fleet import ServingFleet
 from .kv_pool import BlockAllocator, PagedKVPool
+from .kv_transfer import BlockPayload, payload_checksum, verify_payload
 from .metrics import ServingMetrics, aggregate_snapshots
 from .resilience import (
     EngineRestartError,
@@ -50,10 +57,13 @@ from .workload import TraceGenerator, TraceRequest
 
 __all__ = [
     "BlockAllocator",
+    "BlockPayload",
     "ContinuousScheduler",
+    "DisaggFleet",
     "DynamicBatcher",
     "EngineRestartError",
     "FleetAutoscaler",
+    "FleetCacheDirectory",
     "FleetDownError",
     "FleetRouter",
     "HungTickError",
@@ -69,4 +79,6 @@ __all__ = [
     "aggregate_snapshots",
     "build_generate_fn",
     "build_paged_fns",
+    "payload_checksum",
+    "verify_payload",
 ]

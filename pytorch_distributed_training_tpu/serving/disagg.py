@@ -1,0 +1,579 @@
+"""Prefill/decode disaggregation with a fleet-shared KV cache tier.
+
+The DistServe/vLLM-style split the ROADMAP names (item 4): dedicated
+PREFILL replicas absorb the compute-bound prompt phase so the DECODE
+fleet's iteration loop stops stalling behind prefill bursts, and the
+per-replica prefix caches federate into one fleet tier — a miss on the
+replica that will decode but a hit anywhere else becomes a block
+TRANSFER (serving/kv_transfer.py) instead of a recompute.
+
+Topology: the decode fleet is an unmodified :class:`ServingFleet`
+(router placement, failover replay, autoscaler verbs all intact); the
+prefill replicas are extra engines built by the SAME ``replica_factory``
+but never registered with the router — they serve no client traffic,
+only ``max_new_tokens=1`` priming requests that populate their paged
+pool for export.  A :class:`FleetCacheDirectory` maps content-addressed
+first-block keys (the router's affinity-key construction, namespace-
+seeded like kv_pool chain keys) to the DECODE replica currently holding
+that prefix, and `ServingFleet.remove_replica` evicts a retiree's
+entries before its drain starts, so a directory hit can never name a
+replica that is no longer exportable.
+
+Why parity is free: prefill under a fixed (config, params, bucket) is a
+deterministic jit program, so a transferred block is bitwise identical
+to the block the decode replica would have computed itself — a request
+served through any arm of the recovery ladder emits the same tokens.
+
+The recovery ladder — every transfer edge degrades, none fail the
+request:
+
+==========================  =========================================
+transfer edge fault         recovery (counter)
+==========================  =========================================
+prefill replica dies        export future fails -> decode-side local
+mid-transfer                recompute (``serving_disagg_transfer_
+                            recomputes``)
+corrupt/truncated payload   per-block CRC-32 reject at import, chain
+                            dropped, suffix recomputed (``serving_
+                            disagg_rejects`` + the importing engine's
+                            ``kv_transfer_rejects``)
+stalled transfer            bounded ``transfer_deadline_ms`` wait trips
+                            -> colocated path (``serving_disagg_
+                            deadline_degrades``)
+decode replica dies         PR 12 failover replay re-routes the
+mid-handoff                 request; the stranded directory entry is
+                            evicted on its next failed export
+==========================  =========================================
+
+Staging is ASYNC (a small ``disagg-xfer`` worker pool): ``submit``
+returns immediately and the worker stages blocks onto the replica
+``FleetRouter.peek_placement`` names, then chains the real fleet submit
+to the caller's future.  The staging path is wrapped whole in the
+degrade-to-colocated net: any exception inside it is accounting, not an
+error the client sees.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..engine import fault
+from ..telemetry.registry import get_registry
+from . import kv_transfer
+from .fleet import ServingFleet
+
+__all__ = ["DisaggFleet", "FleetCacheDirectory"]
+
+
+class FleetCacheDirectory:
+    """Fleet-shared prefix-cache directory: content key -> holder replica.
+
+    Keys are the router's affinity-key construction — the prompt's first
+    full KV block, seeded with the tenant namespace exactly like
+    kv_pool's chain keys, so cross-tenant (LoRA-namespaced) prompts can
+    never alias an entry and therefore never transfer across
+    namespaces.  Values are decode-replica router indices (the only
+    exportable long-lived holders).  Bounded LRU; thread-safe (router
+    worker threads, drain handlers, and the autoscaler all consult it).
+    Counters mirror into the process registry as
+    ``serving_fleet_cache_*`` so the serve bench and fleet snapshot read
+    one ledger.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"directory capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, int]" = OrderedDict()
+        self._hits = 0
+        self._misses = 0
+        self._rejects = 0
+        self._evictions = 0
+
+    @staticmethod
+    def key_of(prompt, block_size: int, namespace=-1) -> Optional[tuple]:
+        """The prompt's directory identity: ``(namespace, first block)``.
+
+        ``None`` when the prompt cannot contribute a cached block at all
+        (kv_pool caches ``(len - 1) // block_size`` full blocks — same
+        cutoff as the router's affinity key).
+        """
+        prompt = np.asarray(prompt)
+        if block_size < 1 or (int(prompt.size) - 1) // block_size < 1:
+            return None
+        return (namespace, tuple(int(t) for t in prompt[:block_size]))
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        get_registry().counter(f"serving_fleet_cache_{name}").inc(n)
+
+    def publish(self, key: tuple, holder: int) -> None:
+        """Record ``holder`` as the replica owning ``key``'s prefix
+        blocks (last writer wins — the freshest holder is the least
+        likely to have LRU-evicted the blocks locally)."""
+        with self._lock:
+            self._entries[key] = int(holder)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._evictions += 1
+
+    def lookup(self, key: tuple) -> Optional[int]:
+        """The holding replica, or ``None`` (counts the hit/miss)."""
+        with self._lock:
+            holder = self._entries.get(key)
+            if holder is None:
+                self._misses += 1
+            else:
+                self._entries.move_to_end(key)
+                self._hits += 1
+        self._bump("hits" if holder is not None else "misses")
+        return holder
+
+    def count_reject(self, n: int = 1) -> None:
+        """A transferred payload failed its checksum at import."""
+        with self._lock:
+            self._rejects += n
+        self._bump("rejects", n)
+
+    def evict_replica(self, holder: int) -> int:
+        """Drop every entry held by ``holder`` (retire/death coherence);
+        returns how many were evicted."""
+        with self._lock:
+            doomed = [k for k, v in self._entries.items() if v == holder]
+            for k in doomed:
+                del self._entries[k]
+            self._evictions += len(doomed)
+        return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": self._hits,
+                "misses": self._misses,
+                "rejects": self._rejects,
+                "evictions": self._evictions,
+            }
+
+
+class DisaggFleet:
+    """Disaggregated serving: decode :class:`ServingFleet` + prefill
+    replicas + the transfer coordinator.  Mirrors the fleet's client
+    verbs, so benches and tests drive either interchangeably."""
+
+    def __init__(
+        self,
+        fleet: ServingFleet,
+        disagg: Optional[Dict[str, Any]] = None,
+        prefill_replicas: Optional[List[Any]] = None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        """``disagg`` is the raw ``serving.disagg`` config section;
+        ``prefill_replicas`` overrides its ``prefill_replicas`` count
+        with ready-built engines (tests inject hand-ticked ones)."""
+        dcfg = dict(disagg or {})
+        if not bool(dcfg.pop("enabled", True)):
+            raise ValueError(
+                "serving.disagg.enabled is false — build a ServingFleet "
+                "instead of a DisaggFleet"
+            )
+        n_prefill = int(dcfg.pop("prefill_replicas", 1))
+        deadline_ms = float(dcfg.pop("transfer_deadline_ms", 2000.0))
+        capacity = int(dcfg.pop("directory_capacity", 4096))
+        workers = int(dcfg.pop("transfer_workers", 2))
+        if dcfg:
+            raise ValueError(f"unknown serving.disagg keys: {sorted(dcfg)}")
+        if deadline_ms <= 0:
+            raise ValueError(
+                f"transfer_deadline_ms must be > 0, got {deadline_ms}"
+            )
+        if workers < 1:
+            raise ValueError(f"transfer_workers must be >= 1, got {workers}")
+        if n_prefill < 1:
+            raise ValueError(
+                f"serving.disagg.prefill_replicas must be >= 1, got {n_prefill}"
+            )
+        self.fleet = fleet
+        self.router = fleet.router
+        if prefill_replicas is None:
+            # prefill identities start at 100: their serving_r<id>_*
+            # telemetry namespace can never collide with decode replicas
+            # the autoscaler adds later
+            prefill_replicas = [
+                fleet.replica_factory(100 + i) for i in range(n_prefill)
+            ]
+        self.prefill_replicas = list(prefill_replicas)
+        self.directory = FleetCacheDirectory(capacity)
+        # membership coherence: remove_replica evicts through this hook
+        fleet.cache_directory = self.directory
+        self.transfer_deadline_s = deadline_ms / 1000.0
+        self.logger = logger or logging.getLogger("pdt.serving.disagg")
+        self._exec = ThreadPoolExecutor(
+            max_workers=workers,
+            thread_name_prefix="disagg-xfer",
+        )
+        self._lock = threading.Lock()
+        self._xfer_no = 0  # transfer ordinal (1-based) — the fault clock
+        self._staging: set = set()  # keys with a transfer in flight
+        self._dead_prefill: set = set()
+        self._rr = 0  # prefill round-robin cursor
+        self._closed = False
+
+    # ------------------------------------------------------------------ #
+
+    @classmethod
+    def from_config(cls, cfg: Dict[str, Any], logger=None) -> "DisaggFleet":
+        """Build the decode fleet from ``serving.fleet`` and the prefill
+        side from ``serving.disagg`` — one checkpoint resolution total
+        (prefill replicas come from the fleet's stored factory)."""
+        logger = logger or logging.getLogger(__name__)
+        fleet = ServingFleet.from_config(cfg, logger=logger)
+        try:
+            out = cls(fleet, disagg=cfg["serving"].get("disagg"),
+                      logger=logger)
+        except BaseException:
+            fleet.close()
+            raise
+        logger.info(
+            "disaggregated fleet up: %d decode replica(s), %d prefill "
+            "replica(s), transfer deadline %.0f ms",
+            len(fleet.replicas), len(out.prefill_replicas),
+            out.transfer_deadline_s * 1000.0,
+        )
+        return out
+
+    # ------------------------------------------------------------------ #
+    # client verbs
+
+    def submit(
+        self,
+        prompt,
+        deadline_ms: Optional[float] = None,
+        max_new_tokens: Optional[int] = None,
+        on_token: Optional[Callable[[int], None]] = None,
+        rng=None,
+    ) -> Future:
+        """Route one prompt; KV staging happens off-thread first.
+
+        Prompts too short to own a cached block (or submitted after
+        close began) skip staging entirely — the plain colocated path.
+        The returned future resolves with the fleet result; staging
+        failures are counters, never client errors.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        bs = self._block_size()
+        key = (
+            FleetCacheDirectory.key_of(prompt, bs) if bs is not None else None
+        )
+        if key is None:
+            return self.fleet.submit(
+                prompt, deadline_ms=deadline_ms,
+                max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
+            )
+        outer: Future = Future()
+        try:
+            self._exec.submit(
+                self._serve, prompt, key, deadline_ms, max_new_tokens,
+                on_token, rng, outer,
+            )
+        except RuntimeError:  # executor shut down mid-close
+            return self.fleet.submit(
+                prompt, deadline_ms=deadline_ms,
+                max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
+            )
+        return outer
+
+    def depth(self) -> int:
+        return self.fleet.depth()
+
+    def health(self) -> Dict[str, Any]:
+        return self.fleet.health()
+
+    def live_replicas(self) -> int:
+        return self.fleet.live_replicas()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet snapshot + the disagg tier: directory state, transfer
+        ordinal, and per-prefill-replica sub-snapshots."""
+        snap = self.fleet.snapshot()
+        with self._lock:
+            transfers = self._xfer_no
+        snap["disagg"] = {
+            "directory": self.directory.snapshot(),
+            "transfers": transfers,
+            "prefill_replicas": len(self.prefill_replicas),
+            "prefill": {
+                f"p{i}": rep.metrics.snapshot()
+                for i, rep in enumerate(self.prefill_replicas)
+                if hasattr(rep, "metrics")
+            },
+        }
+        return snap
+
+    def drain(self, deadline_ms: Optional[float] = None) -> float:
+        return self.fleet.drain(deadline_ms)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._exec.shutdown(wait=True)
+        for i, rep in enumerate(self.prefill_replicas):
+            try:
+                rep.close()
+            except Exception:
+                self.logger.exception("prefill replica %d close failed", i)
+        self.fleet.close()
+        self._report_unfired_faults()
+
+    def _report_unfired_faults(self) -> None:
+        """Same contract as the scheduler's: an armed transfer fault the
+        coordinator never reached must end the run accounted, not lost."""
+        pending = fault.get_injector().pending()
+        for kind, steps in pending.items():
+            if not (
+                kind.startswith("kv_transfer_") or kind == "prefill_replica_down"
+            ):
+                continue
+            fault.bump(f"fault_unfired_{kind}", len(steps))
+            self.logger.warning(
+                "disagg coordinator closed with injected %s fault(s) still "
+                "armed for transfer(s) %s — no transfer reached them",
+                kind, steps,
+            )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    # staging pipeline (disagg-xfer worker threads)
+
+    def _serve(self, prompt, key, deadline_ms, max_new_tokens, on_token,
+               rng, outer: Future) -> None:
+        try:
+            self._stage(prompt, key)
+        except Exception:
+            # the catch-all rung of the ladder: staging NEVER fails a
+            # request — whatever happened, decode recomputes locally
+            self._bump("transfer_recomputes")
+            self.logger.exception(
+                "disagg staging failed; degrading to colocated recompute"
+            )
+        try:
+            inner = self.fleet.submit(
+                prompt, deadline_ms=deadline_ms,
+                max_new_tokens=max_new_tokens, on_token=on_token, rng=rng,
+            )
+        except Exception as exc:
+            if not outer.done():
+                outer.set_exception(exc)
+            return
+
+        def _chain(f: Future) -> None:
+            if outer.done():
+                return
+            exc = f.exception()
+            if exc is not None:
+                outer.set_exception(exc)
+            else:
+                outer.set_result(f.result())
+
+        inner.add_done_callback(_chain)
+
+    def _stage(self, prompt, key) -> None:
+        """Make ``key``'s prefix blocks local to the decode target."""
+        with self._lock:
+            if self._closed or key in self._staging:
+                # single-flight per key: the second waiter follows the
+                # sticky placement and hits whatever the first landed
+                return
+            self._staging.add(key)
+        try:
+            self._stage_inner(prompt, key)
+        finally:
+            with self._lock:
+                self._staging.discard(key)
+
+    def _stage_inner(self, prompt, key) -> None:
+        target = self.router.peek_placement(prompt)
+        if target is None:
+            return  # nothing healthy: the fleet submit will shed/raise
+        holder = self.directory.lookup(key)
+        if holder == target:
+            return  # fleet-cache hit, already local to the decode target
+        source = None
+        if holder is not None:
+            source = self._sched_of_decode(holder)
+            if source is None:
+                # stranded entry (holder died outside the retire path)
+                self.directory.evict_replica(holder)
+                holder = None
+        if holder is None:
+            source = self._prefill_source(prompt)
+            if source is None:
+                return  # no prefill capacity left: plain colocated path
+        self._transfer(prompt, key, source, holder, target)
+
+    def _transfer(self, prompt, key, source, holder, target) -> None:
+        """One ordinal on the transfer clock: export from ``source``,
+        CRC-verify + import at ``target``, publish on success.  The
+        injected ``kv_transfer_*``/``prefill_replica_down`` faults key
+        on this ordinal."""
+        with self._lock:
+            self._xfer_no += 1
+            ordinal = self._xfer_no
+        stall_s = corrupt = None
+        inj = fault.get_injector()
+        if inj.active:
+            down = inj.take("prefill_replica_down", ordinal)
+            if down is not None:
+                self._kill_prefill(int(down))
+            stall_s = inj.take("kv_transfer_stall", ordinal)
+            corrupt = inj.take("kv_transfer_corrupt", ordinal)
+        tgt_sched = self._sched_of_decode(target)
+        if tgt_sched is None:
+            return
+        self._bump("transfers")
+        t0 = time.perf_counter()
+        try:
+            payloads = source.export_kv_prefix(
+                prompt, namespace=-1, stall_s=stall_s,
+            ).result(timeout=self.transfer_deadline_s)
+            if not payloads:
+                # the source LRU-evicted the prefix between directory
+                # lookup and export: recompute, and unpublish the holder
+                if holder is not None:
+                    self.directory.evict_replica(holder)
+                self._bump("transfer_recomputes")
+                return
+            if corrupt is not None:
+                kv_transfer.corrupt_payload(payloads[0])
+                self.logger.warning(
+                    "fault injection: corrupted kv payload on transfer %d",
+                    ordinal,
+                )
+            res = tgt_sched.import_kv_blocks(payloads).result(
+                timeout=self.transfer_deadline_s
+            )
+        except (TimeoutError, FutureTimeoutError):
+            self._bump("deadline_degrades")
+            self.logger.warning(
+                "kv transfer %d exceeded its %.0f ms deadline; degrading "
+                "to the colocated path", ordinal,
+                self.transfer_deadline_s * 1000.0,
+            )
+            return
+        except Exception as exc:
+            # source or target died mid-transfer (the headline fault):
+            # the request recomputes/replays wherever it lands
+            self._bump("transfer_recomputes")
+            if holder is not None:
+                self.directory.evict_replica(holder)
+            self.logger.warning(
+                "kv transfer %d failed (%s: %s); degrading to local "
+                "recompute", ordinal, type(exc).__name__, exc,
+            )
+            return
+        if res["rejected"]:
+            self.directory.count_reject(res["rejected"])
+            self._bump("rejects", res["rejected"])
+        if res["accepted"] or not res["rejected"]:
+            # the target now holds at least the verified prefix (an
+            # all-skipped import means it already held everything)
+            self.directory.publish(key, target)
+        self.logger.debug(
+            "kv transfer %d: %d block(s)/%d bytes to replica %d in %.1f ms",
+            ordinal, res["accepted"], res["bytes"], target,
+            (time.perf_counter() - t0) * 1000.0,
+        )
+
+    # ------------------------------------------------------------------ #
+    # helpers
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        get_registry().counter(f"serving_disagg_{name}").inc(n)
+
+    @staticmethod
+    def _sched_of(rep):
+        # engines carry a .scheduler; tests hand in bare schedulers
+        return getattr(rep, "scheduler", rep)
+
+    def _block_size(self) -> Optional[int]:
+        reps = self.fleet.replicas
+        if not reps:
+            return None
+        return getattr(self._sched_of(reps[0]), "_block_size", None)
+
+    def _sched_of_decode(self, idx: int):
+        """The decode replica's scheduler iff it is still usable."""
+        reps = self.fleet.replicas
+        if not 0 <= idx < len(reps):
+            return None
+        sched = self._sched_of(reps[idx])
+        if sched is None or sched._closed or sched._dead:
+            return None
+        return sched
+
+    def _prefill_source(self, prompt):
+        """Prime a prefill replica's pool with this prompt and return its
+        scheduler as the export source (round-robin over survivors)."""
+        n = len(self.prefill_replicas)
+        for _ in range(n):
+            with self._lock:
+                idx = self._rr % n
+                self._rr += 1
+                if idx in self._dead_prefill:
+                    continue
+            rep = self.prefill_replicas[idx]
+            try:
+                # exactly one prefill program call: max_new_tokens=1
+                # samples its token from the prefill logits and stops —
+                # the token is discarded, the registered prefix is the
+                # product
+                rep.submit(prompt, max_new_tokens=1).result(timeout=600)
+                return self._sched_of(rep)
+            except Exception as exc:
+                with self._lock:
+                    self._dead_prefill.add(idx)
+                self.logger.warning(
+                    "prefill replica %d unusable (%s: %s); trying the next",
+                    idx, type(exc).__name__, exc,
+                )
+        self._bump("prefill_unavailable")
+        return None
+
+    def _kill_prefill(self, idx: int) -> None:
+        """The ``prefill_replica_down`` fault: hard-kill prefill replica
+        ``idx`` so the in-flight export dies mid-transfer."""
+        if not 0 <= idx < len(self.prefill_replicas):
+            return
+        self.logger.warning(
+            "fault injection: prefill replica %d down mid-transfer", idx
+        )
+        self._bump("prefill_replicas_down")
+        sched = self._sched_of(self.prefill_replicas[idx])
+        if sched is not None:
+            sched.hard_kill(
+                fault.DeviceLostError(
+                    f"injected prefill replica {idx} loss mid-transfer"
+                )
+            )
+        with self._lock:
+            self._dead_prefill.add(idx)

@@ -70,6 +70,11 @@ class ServingFleet:
         # autoscaler's scale-up path; from_config installs one that
         # reuses its single checkpoint resolution
         self.replica_factory = replica_factory
+        # fleet-shared prefix-cache directory (serving/disagg.py): when a
+        # DisaggFleet wraps this fleet it installs its FleetCacheDirectory
+        # here so membership changes keep the directory coherent —
+        # remove_replica evicts the retiree's entries BEFORE drain starts
+        self.cache_directory = None
         self._next_replica_id = len(self._replicas)
         self._closed = False
         self._close_lock = threading.Lock()
@@ -245,6 +250,15 @@ class ServingFleet:
         retiree finish on the retiree, bitwise-identical to an unscaled
         run — scale-down inherits the drain parity oracle."""
         self.router.retire_replica(idx)
+        if self.cache_directory is not None:
+            # coherence before drain: a directory hit must never name a
+            # retiree — once retired it can no longer export its blocks
+            evicted = self.cache_directory.evict_replica(idx)
+            if evicted:
+                self.logger.info(
+                    "evicted %d fleet-cache entr%s held by retiring "
+                    "replica %d", evicted, "y" if evicted == 1 else "ies",
+                    idx)
         with self._close_lock:
             rep = self._replicas[idx]
             already = idx in self._removed

@@ -215,6 +215,59 @@ class PagedKVPool:
             self._cache[key] = blk
             self._ref[blk] += 1  # the cache's own reference
 
+    def cached_chain(
+        self, prompt: Sequence[int], namespace=None
+    ) -> List[Tuple[tuple, int]]:
+        """Longest cached chain as ``(chain_key, block_id)`` pairs.
+
+        The KV-transfer exporter's view (serving/kv_transfer.py): the
+        keys travel with the block payloads so the importing pool can
+        publish them under identical content addresses — equal keys
+        imply bitwise-equal K/V, which is what makes a transferred
+        prefix interchangeable with a locally-computed one.  Touches
+        LRU recency like :meth:`lookup_prefix` (an exported block is a
+        hot block); takes no references — the cache's own ref keeps the
+        blocks alive for the duration of the host-side copy because
+        extraction happens synchronously on the scheduler thread."""
+        out: List[Tuple[tuple, int]] = []
+        if not self.prefix_cache:
+            return out
+        for key, _ in self._chain_keys(prompt, namespace):
+            blk = self._cache.get(key)
+            if blk is None:
+                break
+            self._cache.move_to_end(key)
+            out.append((key, blk))
+        return out
+
+    def is_cached(self, key: tuple) -> bool:
+        """Whether a chain key is already published (first-writer-wins:
+        the importer skips blocks some local prefill beat it to)."""
+        return key in self._cache
+
+    def adopt_block(self, key: tuple) -> Optional[int]:
+        """Allocate one block to hold a TRANSFERRED cache entry.
+
+        The cache holds the only reference (exactly the state a
+        registered-then-released local prefill leaves behind), so the
+        adopted block competes in the same LRU eviction order as native
+        entries.  ``None`` when the pool cannot free a block even after
+        LRU eviction, or when prefix caching is disabled — the importer
+        stops the chain there and the decode side recomputes the rest."""
+        if not self.prefix_cache:
+            return None
+        if key in self._cache:
+            raise ValueError(
+                f"chain key already cached (check is_cached first): {key!r}"
+            )
+        got = self._alloc_with_evict(1)
+        if got is None:
+            return None
+        blk = got[0]
+        self._ref[blk] = 1
+        self._cache[key] = blk
+        return blk
+
     def release(self, admission: Admission) -> None:
         """Drop the request's references; zero-ref blocks recycle."""
         for b in admission.block_ids:

@@ -1,0 +1,187 @@
+"""Host-staged KV-block transfer protocol for disaggregated serving.
+
+The wire format between a prefill replica's paged pool and a decode
+replica's (serving/disagg.py): each transferred unit is ONE physical
+block — ``block_size`` token rows gathered from every per-row pool leaf
+— addressed by the pool's content-chained prefix key (kv_pool.py
+``_chain_keys``) and sealed with a per-block CRC-32 over the raw bytes,
+the same checksum scheme the checkpoint manifest uses for corruption
+detection (engine/integrity.py ``leaf_checksums``).  Content addressing
+is what makes the transfer safe to dedupe and replay: equal keys imply
+bitwise-equal K/V (prefill with identical config/params/bucket is a
+deterministic jit program), so an imported block is interchangeable
+with a locally-recomputed one and token parity holds by construction.
+
+Host-staged on purpose: blocks round-trip through ``numpy`` arrays
+(device → host gather on export, host → device scatter on import)
+because the single-process fleet has no device-to-device fabric to
+model — the honest cost of that staging on CPU is measured by
+``bench.py disagg`` and documented in PERF.md, not hidden.
+
+This module is pure data plumbing — no locks, no threads, no pool
+mutation beyond the functional ``.at[].set`` scatter.  The scheduler
+owns WHEN extraction/scattering happen (on its loop thread, at tick
+boundaries); serving/disagg.py owns the recovery ladder around failed
+or corrupt transfers.
+"""
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "BlockPayload",
+    "corrupt_payload",
+    "extract_payloads",
+    "payload_checksum",
+    "pool_row_leaves",
+    "scatter_payloads",
+    "verify_payload",
+]
+
+
+def _path_name(path) -> str:
+    return "/".join(
+        str(getattr(part, "key", getattr(part, "name", ""))) for part in path
+    )
+
+
+def pool_row_leaves(pool, n_rows: int) -> List[Tuple[str, Any]]:
+    """``(name, leaf)`` for every per-row KV pool leaf, sorted by name.
+
+    Identified structurally the same way the chaos SDC injector finds
+    its corruption targets (scheduler ``_corrupt_pool_rows``): leading
+    dimension equal to ``num_blocks * block_size`` and a path naming a
+    k/v pool.  Sorted order makes the leaf set deterministic on both
+    ends of a transfer, which the chained checksum relies on.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(pool)[0]
+    out: List[Tuple[str, Any]] = []
+    for path, leaf in flat:
+        name = _path_name(path)
+        if "k_pool" not in name and "v_pool" not in name:
+            continue
+        if hasattr(leaf, "shape") and leaf.ndim >= 1 and leaf.shape[0] == n_rows:
+            out.append((name, leaf))
+    out.sort(key=lambda kv: kv[0])
+    return out
+
+
+def payload_checksum(key: tuple, index: int, arrays: Dict[str, np.ndarray]) -> int:
+    """CRC-32 chained over the block's identity and every leaf's bytes.
+
+    The identity (chain key + block index) is part of the digest so a
+    payload cannot be silently replayed under a different address; each
+    leaf contributes a ``name:dtype:shape`` header before its raw bytes
+    (the integrity-manifest idiom) so truncation or a reshaped array
+    fails the check, not just flipped bits.
+    """
+    crc = zlib.crc32(repr((key, index)).encode())
+    for name in sorted(arrays):
+        arr = arrays[name]
+        crc = zlib.crc32(f"{name}:{arr.dtype}:{arr.shape}".encode(), crc)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+@dataclass
+class BlockPayload:
+    """One physical block in flight: ``block_size`` rows of every pool
+    leaf, keyed by the content-chained prefix address, CRC-sealed."""
+
+    key: tuple
+    index: int  # position of this block in the prefix chain, 0-based
+    arrays: Dict[str, np.ndarray]  # leaf name -> [block_size, ...] rows
+    crc: int
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(a.nbytes) for a in self.arrays.values())
+
+
+def extract_payloads(
+    kv, pool, prompt: Sequence[int], namespace=None
+) -> List[BlockPayload]:
+    """Gather the longest cached chain for ``prompt`` into payloads.
+
+    Runs on the source scheduler's loop thread (single-thread pool
+    confinement): the cache's own reference keeps every chain block
+    alive for the duration of the host copy, so no refcounts are taken.
+    Cached blocks are fully written by construction — registration is
+    capped at ``(prompt_len - 1) // block_size`` FULL blocks.
+    """
+    chain = kv.cached_chain(prompt, namespace)
+    if not chain:
+        return []
+    bs = kv.block_size
+    leaves = pool_row_leaves(pool, kv.num_blocks * bs)
+    out: List[BlockPayload] = []
+    for index, (key, blk) in enumerate(chain):
+        rows = slice(blk * bs, (blk + 1) * bs)
+        arrays = {name: np.asarray(leaf[rows]) for name, leaf in leaves}
+        out.append(
+            BlockPayload(
+                key=key,
+                index=index,
+                arrays=arrays,
+                crc=payload_checksum(key, index, arrays),
+            )
+        )
+    return out
+
+
+def verify_payload(payload: BlockPayload) -> bool:
+    """Recompute the CRC over what actually arrived."""
+    return (
+        payload_checksum(payload.key, payload.index, payload.arrays)
+        == payload.crc
+    )
+
+
+def corrupt_payload(payload: BlockPayload) -> None:
+    """Flip one byte of the first leaf AFTER sealing (fault-injection
+    hook for ``kv_transfer_corrupt``): the stale CRC must now reject.
+    Dtype-agnostic via a bytes round-trip — bf16 has no numpy view."""
+    name = sorted(payload.arrays)[0]
+    arr = payload.arrays[name]
+    raw = bytearray(arr.tobytes())
+    raw[0] ^= 0xFF
+    payload.arrays[name] = np.frombuffer(
+        bytes(raw), dtype=arr.dtype
+    ).reshape(arr.shape)
+
+
+def scatter_payloads(pool, n_rows: int, accepted: List[Tuple[int, BlockPayload]]):
+    """Write accepted payloads into their adopted blocks, one scatter
+    per leaf (batched ``.at[rows].set``), returning the updated pool.
+
+    ``accepted`` pairs each payload with the LOCAL block id the
+    importing pool adopted for it — physical ids are replica-private;
+    only the content keys travel.
+    """
+    if not accepted:
+        return pool
+    names = sorted(accepted[0][1].arrays)
+    rows_parts: List[np.ndarray] = []
+    vals: Dict[str, List[np.ndarray]] = {name: [] for name in names}
+    for blk, payload in accepted:
+        bsz = payload.arrays[names[0]].shape[0]
+        rows_parts.append(np.arange(blk * bsz, (blk + 1) * bsz))
+        for name in names:
+            vals[name].append(payload.arrays[name])
+    rows = np.concatenate(rows_parts)
+    stacked = {name: np.concatenate(vals[name]) for name in names}
+
+    def _write(path, leaf):
+        name = _path_name(path)
+        if name in stacked and hasattr(leaf, "shape") and leaf.shape[:1] == (
+            n_rows,
+        ):
+            return leaf.at[rows].set(stacked[name].astype(leaf.dtype))
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(_write, pool)

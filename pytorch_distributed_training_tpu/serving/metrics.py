@@ -56,6 +56,11 @@ class ServingMetrics:
         # block-pool utilization, both recorded as fractions in [0, 1]
         self._slot_occ = self._registry.histogram("slot_occupancy", _RESERVOIR)
         self._block_util = self._registry.histogram("block_util", _RESERVOIR)
+        # disaggregated serving (PR 19): per-import host-staging wall
+        # time; the byte/block counters ride the counter namespace
+        self._kv_transfer_ms = self._registry.histogram(
+            "kv_transfer_ms", _RESERVOIR
+        )
         self._items = 0  # guarded by: self._lock
         self._first_t: Optional[float] = None  # guarded by: self._lock
         self._last_t: Optional[float] = None  # guarded by: self._lock
@@ -214,6 +219,19 @@ class ServingMetrics:
         self._slot_occ.observe(active_slots / max(total_slots, 1))
         self._block_util.observe(blocks_in_use / max(total_blocks, 1))
 
+    def record_kv_transfer(
+        self, *, nbytes: int, seconds: float, blocks: int
+    ) -> None:
+        """One serviced KV-block import (disaggregated serving): bytes
+        and blocks that actually landed plus the host-staging wall time.
+        Rejected payloads are counted by the scheduler's
+        ``kv_transfer_rejects`` counter, not here."""
+        if nbytes:
+            self._registry.counter("kv_transfer_bytes").inc(int(nbytes))
+        if blocks:
+            self._registry.counter("kv_transfer_blocks").inc(int(blocks))
+        self._kv_transfer_ms.observe(float(seconds) * 1000.0)
+
     def observe_depth(self, depth: int) -> None:
         with self._lock:
             self._max_depth = max(self._max_depth, depth)
@@ -288,6 +306,10 @@ class ServingMetrics:
         if util["count"]:
             out["block_util_mean"] = float(util["mean"])
             out["block_util_max"] = float(util["max"])
+        xfer = self._kv_transfer_ms.snapshot()
+        if xfer["count"]:
+            out["kv_transfer_ms_p50"] = float(xfer["p50"])
+            out["kv_transfer_ms_p99"] = float(xfer["p99"])
         counters = self._registry.counters()
         hits = counters.get("prefix_hit_blocks", 0)
         misses = counters.get("prefix_miss_blocks", 0)
@@ -356,7 +378,7 @@ _AGG_SUM = ("requests", "batches", "items", "gen_tokens")
 # percentiles, but the MAX is a valid (and operationally honest) bound
 _AGG_MAX = (
     "latency_ms_p50", "latency_ms_p99", "max_queue_depth",
-    "block_util_max",
+    "block_util_max", "kv_transfer_ms_p50", "kv_transfer_ms_p99",
 )
 
 

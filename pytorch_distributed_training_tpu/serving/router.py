@@ -329,6 +329,25 @@ class FleetRouter:
             raise
         return freq.future
 
+    def peek_placement(self, prompt) -> Optional[int]:
+        """Where would :meth:`submit` route this prompt right now?
+
+        The disagg coordinator (serving/disagg.py) asks BEFORE staging a
+        KV transfer so the blocks land on the replica that will actually
+        decode.  Runs the real placement (sticky registration included),
+        so the follow-up ``submit`` of the same prompt lands on the
+        returned replica unless it dies in between — and if it does, the
+        transfer was wasted work, not a correctness event.  ``None``
+        when no healthy replica is admissible.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        healthy = self._healthy()  # replica calls — before taking _lock
+        with self._lock:
+            if self._closed:
+                return None
+            key = self._affinity_key_locked(prompt)
+            return self._place_locked(key, healthy)
+
     def depth(self) -> int:
         """Requests accepted by the router and not yet resolved."""
         with self._lock:

@@ -69,6 +69,7 @@ from ..engine.watchdog import StepWatchdog
 from ..telemetry.registry import get_registry
 from ..telemetry.spans import span
 from ..ops.quant import quantize_tree
+from . import kv_transfer
 from .batcher import OverloadedError
 from .decode import build_paged_fns
 from .kv_pool import PagedKVPool
@@ -293,6 +294,11 @@ class ContinuousScheduler:
         # lock-free by thread confinement (see module docstring).
         self._slots: List[Optional[_PagedRequest]] = [None] * self.slots_n  # confined: _loop
         self._queue: "deque[_PagedRequest]" = deque()  # guarded by: self._cond
+        # cross-replica KV transfer verbs (serving/kv_transfer.py):
+        # foreign threads enqueue export/import requests here and the
+        # scheduler thread services them at its next tick boundary, so
+        # pool reads and scatters keep their single-thread confinement
+        self._xfer_q: deque = deque()  # guarded by: self._cond
         self._cond = threading.Condition()
         self._closed = False  # guarded by: self._cond
         self._draining = False  # guarded by: self._cond
@@ -610,6 +616,51 @@ class ContinuousScheduler:
             self._hang_sec = float(seconds)
             self._cond.notify_all()
 
+    def export_kv_prefix(
+        self,
+        prompt: Sequence[int],
+        namespace=None,
+        stall_s: Optional[float] = None,
+    ) -> Future:
+        """Stage ``prompt``'s cached prefix blocks for transfer (any thread).
+
+        Resolves to a list of CRC-sealed :class:`kv_transfer.BlockPayload`
+        — possibly empty when nothing is cached.  The host-side gather
+        runs on the scheduler thread at its next tick boundary, so the
+        pool is quiescent for the copy.  ``stall_s`` is the
+        ``kv_transfer_stall`` fault hook: the SOURCE side sleeps before
+        resolving, so the importing coordinator's bounded deadline is
+        exercised against a genuinely late payload.
+        """
+        fut: Future = Future()
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        with self._cond:
+            if self._closed or self._dead:
+                raise RuntimeError("cannot export KV from a closed scheduler")
+            self._xfer_q.append(("export", (arr, namespace, stall_s), fut))
+            self._cond.notify_all()
+        return fut
+
+    def import_kv_blocks(self, payloads) -> Future:
+        """Adopt transferred blocks into the local prefix cache (any thread).
+
+        Resolves to ``{"accepted", "rejected", "bytes"}``.  Per payload,
+        in chain order: a checksum mismatch rejects the block AND stops
+        the chain (descendants of a corrupt link would be unreachable),
+        an already-cached key is skipped (first-writer-wins — a local
+        prefill beat the transfer), a full pool stops the chain.  Bad
+        payloads never raise: rejection is an accounted, recoverable
+        event (``kv_transfer_rejects``) and the decode side simply
+        recomputes whatever did not land.
+        """
+        fut: Future = Future()
+        with self._cond:
+            if self._closed or self._dead:
+                raise RuntimeError("cannot import KV into a closed scheduler")
+            self._xfer_q.append(("import", list(payloads), fut))
+            self._cond.notify_all()
+        return fut
+
     def close(self) -> None:
         """Drain queue and in-flight slots, then stop the loop."""
         with self._cond:
@@ -733,6 +784,8 @@ class ContinuousScheduler:
                 )
             )
             return True
+        self._tick_phase = "kv_transfer"
+        did_xfer = self._service_kv_transfers()
         self._tick_phase = "admit"
         newly = self._admit()
         self._tick_phase = "prefill"
@@ -748,7 +801,7 @@ class ContinuousScheduler:
             else:
                 self._decode_step()
         self._publish_pool_gauges()
-        return bool(newly) or n_active > 0
+        return bool(newly) or n_active > 0 or did_xfer
 
     def _bump(self, name: str, n: int = 1) -> None:
         """Engine-local AND process-global: the snapshot shows the
@@ -800,6 +853,90 @@ class ContinuousScheduler:
             reg.gauge(self.metrics.global_name("prefix_hit_rate")).set(
                 self._hit_blocks / total
             )
+
+    # ------------------------------------------------------------------ #
+    # KV transfer service (disaggregated serving — serving/disagg.py
+    # coordinates; serving/kv_transfer.py is the wire format)
+
+    def _service_kv_transfers(self) -> bool:
+        """Run queued export/import verbs on the scheduler thread."""
+        did = False
+        while True:
+            with self._cond:
+                if not self._xfer_q:
+                    return did
+                verb, arg, fut = self._xfer_q.popleft()
+            did = True
+            try:
+                if verb == "export":
+                    res = self._export_kv(*arg)
+                else:
+                    res = self._import_kv(arg)
+            except Exception as exc:
+                # the verb failed, not the engine: the pool was either
+                # only read (export) or mutated through invariant-safe
+                # adopt/scatter (import) — fail the one future and move on
+                if not fut.done():
+                    fut.set_exception(exc)
+            else:
+                if not fut.done():
+                    fut.set_result(res)
+
+    def _export_kv(self, prompt, namespace, stall_s):
+        payloads = kv_transfer.extract_payloads(
+            self._kv, self._pool, prompt, namespace=namespace
+        )
+        if payloads:
+            self._bump("kv_transfer_exported_blocks", len(payloads))
+        if stall_s is not None:
+            self.logger.warning(
+                "fault injection: kv transfer export stalled %.2fs", stall_s
+            )
+            time.sleep(float(stall_s))
+        return payloads
+
+    def _import_kv(self, payloads):
+        t0 = time.perf_counter()
+        accepted = []
+        rejected = 0
+        nbytes = 0
+        for p in payloads:
+            if not kv_transfer.verify_payload(p):
+                rejected += 1
+                self._bump("kv_transfer_rejects")
+                self.logger.warning(
+                    "kv transfer: checksum reject of block %d — dropping "
+                    "the rest of the chain; decode recomputes locally",
+                    p.index,
+                )
+                break
+            if self._kv.is_cached(p.key):
+                continue
+            blk = self._kv.adopt_block(p.key)
+            if blk is None:
+                break  # pool full even after LRU eviction: partial adopt is fine
+            accepted.append((blk, p))
+            nbytes += p.nbytes
+        if accepted:
+            self._pool = kv_transfer.scatter_payloads(
+                self._pool, self._kv.num_blocks * self._kv.block_size, accepted
+            )
+        if accepted or rejected:
+            self.metrics.record_kv_transfer(
+                nbytes=nbytes,
+                seconds=time.perf_counter() - t0,
+                blocks=len(accepted),
+            )
+            reg = get_registry()
+            if nbytes:
+                reg.counter(
+                    self.metrics.global_name("kv_transfer_bytes")
+                ).inc(nbytes)
+            if accepted:
+                reg.counter(
+                    self.metrics.global_name("kv_transfer_blocks")
+                ).inc(len(accepted))
+        return {"accepted": len(accepted), "rejected": rejected, "bytes": nbytes}
 
     def _expire(self, req: _PagedRequest, now: float) -> bool:
         if req.deadline is None or now < req.deadline:
@@ -1487,12 +1624,15 @@ class ContinuousScheduler:
         replica loss, and fail the requests over to a survivor."""
         self.logger.error("replica hard-killed: %s", exc)
         self._bump("replica_down")
-        self._fail_inflight(exc)
+        # flags first: once _dead is visible, export/import verbs refuse
+        # new work, so the _fail_inflight drain below cannot race a KV
+        # transfer into a queue nobody will ever service again
         with self._cond:
             self._die_exc = None
             self._dead = True
             self._closed = True
             self._cond.notify_all()
+        self._fail_inflight(exc)
 
     def _fail_inflight(self, exc: BaseException) -> None:
         """A device error poisons every in-flight request (their pool
@@ -1503,6 +1643,14 @@ class ContinuousScheduler:
             doomed.extend(self._queue)
             self._queue.clear()
             self._slots = [None] * self.slots_n
+            doomed_xfer = list(self._xfer_q)
+            self._xfer_q.clear()
+        # pending KV transfers die with the engine state they index; the
+        # disagg coordinator catches the failure and degrades to local
+        # recompute — a transfer error never fails a serving request
+        for _verb, _arg, xfut in doomed_xfer:
+            if not xfut.done():
+                xfut.set_exception(exc)
         for req in doomed:
             if req.admission is not None:
                 self._kv.release(req.admission)
@@ -1594,6 +1742,7 @@ class ContinuousScheduler:
                     or self._die_exc is not None
                     or self._hang_sec is not None
                     or self._queue
+                    or self._xfer_q
                     or any(s is not None for s in self._slots)
                 ):
                     if self.heartbeat_path is None:
@@ -1609,6 +1758,7 @@ class ContinuousScheduler:
                 if (
                     self._closed
                     and not self._queue
+                    and not self._xfer_q
                     and all(s is None for s in self._slots)
                 ):
                     return

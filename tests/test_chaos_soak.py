@@ -27,6 +27,7 @@ from pytorch_distributed_training_tpu.engine.chaos import (
     ChaosSoakEngine,
     ScenarioGenerator,
     coverage_matrix,
+    disagg_cells,
     registered_fault_kinds,
     scaling_cells,
     uncovered_kinds,
@@ -106,6 +107,25 @@ def test_scaling_cells_cover_scale_up_drain_and_decision():
     assert "autoscale_hang" in registered_fault_kinds()
 
 
+def test_disagg_cells_cover_transfer_and_handoff():
+    """ISSUE 19 acceptance: the coverage matrix gains KV-TRANSFER cells
+    — faults on the prefill->decode transfer edge and decode death
+    mid-handoff — each populated from the disagg-family templates, so
+    killing a template empties a cell and fails here."""
+    assert "disagg" in FAMILIES
+    cells = disagg_cells()
+    assert set(cells) == {"transfer", "handoff"}
+    assert set(cells["transfer"]) == {
+        "kv_transfer_stall", "kv_transfer_corrupt", "prefill_replica_down"
+    }
+    assert cells["handoff"] == ["replica_down"]
+    # the transfer kinds are first-class registered faults, not harness
+    # hacks: they appear in the menu AND the injector grammar
+    for kind in cells["transfer"]:
+        assert kind in FAULT_MENU
+        assert kind in registered_fault_kinds()
+
+
 def test_uncovered_kinds_detects_a_coverage_gap(monkeypatch):
     """The matrix check is live, not vacuous: registering a new kind in
     fault.py without adding soak coverage is reported."""
@@ -181,6 +201,26 @@ def test_soak_smoke_scaling_family():
     assert r["family"] == "scaling"
     assert r["scale_ups"] >= 1 and r["scale_downs"] >= 1
     assert r["counters"], "scenario fired nothing"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_soak_smoke_disagg_family():
+    """One seeded disagg scenario end to end: KV blocks stream from a
+    prefill replica to the router-chosen decode replica, injected
+    transfer faults (stall / corrupt / prefill death / decode handoff
+    death) each land on their recovery rung, and all 8 streams match
+    the uninjected twin bit for bit."""
+    eng = ChaosSoakEngine(seed=3, families=("disagg",))
+    summary = eng.run(1)
+    assert summary["failed"] == 0, [
+        r["failures"] for r in summary["results"] if not r["ok"]
+    ]
+    assert summary["passed"] == 1
+    r = summary["results"][0]
+    assert r["family"] == "disagg"
+    assert r["parity"] is True
+    assert r["counters"].get("serving_disagg_transfers", 0) >= 1
 
 
 @pytest.mark.slow

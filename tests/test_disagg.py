@@ -1,0 +1,473 @@
+"""Disaggregated prefill/decode oracles (serving/disagg.py, kv_transfer.py).
+
+The load-bearing oracle mirrors ISSUE 19's acceptance bar: a KV prefix
+TRANSFERRED from one replica's paged pool into another's is **bitwise
+identical** to the prefix the destination would have computed itself —
+so a request decoded over imported blocks emits the same token stream
+as a cold recompute, and every rung of the recovery ladder (checksum
+reject, empty export, pool-full stop) degrades to that recompute
+without changing a single token.
+
+Determinism: schedulers are built with ``start=False`` and ticked by
+hand — export/import futures resolve at an explicit ``tick()``, so
+ordering is scripted, not raced.  The end-to-end coordinator test
+(threaded schedulers + the disagg-xfer worker) is the one exception
+and pins thread hygiene on the way out.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_training_tpu.engine import fault
+from pytorch_distributed_training_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_training_tpu.serving import kv_transfer
+from pytorch_distributed_training_tpu.serving.disagg import (
+    DisaggFleet,
+    FleetCacheDirectory,
+)
+from pytorch_distributed_training_tpu.serving.fleet import ServingFleet
+from pytorch_distributed_training_tpu.serving.kv_transfer import (
+    BlockPayload,
+    corrupt_payload,
+    payload_checksum,
+    verify_payload,
+)
+from pytorch_distributed_training_tpu.serving.router import FleetRouter
+from pytorch_distributed_training_tpu.serving.scheduler import ContinuousScheduler
+
+VOCAB = 61
+
+
+def small_lm(**kwargs):
+    return TransformerLM(
+        vocab_size=VOCAB, max_len=32, embed_dim=32, depth=2, num_heads=4, **kwargs
+    )
+
+
+@pytest.fixture(scope="module")
+def lm_and_params():
+    model = small_lm()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    return model, params
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    fault.install(None)
+    fault.reset_counters()
+    yield
+    fault.install(None)
+    fault.reset_counters()
+
+
+def _mk_replica(model, params, replica_id, **kw):
+    defaults = dict(
+        slots=4, block_size=4, num_blocks=16, batch_buckets=[4],
+        seq_buckets=[16], max_new_tokens=8, temperature=0.0, eos_id=None,
+        prefix_cache=True, start=False, replica_id=replica_id,
+    )
+    defaults.update(kw)
+    return ContinuousScheduler(model, params, **defaults)
+
+
+def _serve(sched, prompt, limit=300, **kw):
+    fut = sched.submit(prompt, **kw)
+    n = 0
+    while not fut.done():
+        sched.tick()
+        n += 1
+        assert n < limit, "hand-ticked serve did not converge"
+    return list(map(int, fut.result()["tokens"]))
+
+
+def _export(sched, prompt, namespace=-1):
+    fut = sched.export_kv_prefix(prompt, namespace=namespace)
+    sched.tick()
+    return fut.result(timeout=5)
+
+
+def _import(sched, payloads):
+    fut = sched.import_kv_blocks(payloads)
+    sched.tick()
+    return fut.result(timeout=5)
+
+
+# 13 tokens -> (13 - 1) // 4 = 3 full cached blocks, a real chain
+PROMPT = np.array(
+    [7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53], np.int32
+)
+
+
+# --------------------------------------------------------------------- #
+# FleetCacheDirectory units (no jax involved)
+
+
+def test_key_of_short_prompts_and_namespaces():
+    # too short to own one FULL cached block -> no directory identity
+    assert FleetCacheDirectory.key_of([1, 2, 3, 4], 4) is None
+    assert FleetCacheDirectory.key_of([1, 2], 4) is None
+    key = FleetCacheDirectory.key_of([1, 2, 3, 4, 5], 4)
+    assert key == (-1, (1, 2, 3, 4))
+    # tenant namespaces can never alias: same tokens, different identity
+    assert FleetCacheDirectory.key_of([1, 2, 3, 4, 5], 4, namespace=0) != key
+    # ... and the identity is the first block only (suffix-independent)
+    assert FleetCacheDirectory.key_of([1, 2, 3, 4, 9, 9], 4) == key
+
+
+def test_directory_publish_lookup_and_lru_bound():
+    d = FleetCacheDirectory(capacity=2)
+    d.publish(("a",), 0)
+    d.publish(("b",), 1)
+    assert d.lookup(("a",)) == 0  # refreshes recency
+    d.publish(("c",), 1)  # capacity 2: evicts the LRU entry ("b",)
+    assert d.lookup(("b",)) is None
+    assert d.lookup(("a",)) == 0 and d.lookup(("c",)) == 1
+    d.publish(("a",), 1)  # last writer wins
+    assert d.lookup(("a",)) == 1
+    snap = d.snapshot()
+    assert snap["entries"] == 2 and snap["capacity"] == 2
+    assert snap["hits"] == 4 and snap["misses"] == 1
+    assert snap["evictions"] == 1
+    with pytest.raises(ValueError):
+        FleetCacheDirectory(capacity=0)
+
+
+def test_directory_evict_replica_drops_only_that_holder():
+    d = FleetCacheDirectory()
+    d.publish(("a",), 0)
+    d.publish(("b",), 1)
+    d.publish(("c",), 1)
+    assert d.evict_replica(1) == 2
+    assert len(d) == 1
+    assert d.lookup(("a",)) == 0
+    assert d.lookup(("b",)) is None and d.lookup(("c",)) is None
+    assert d.snapshot()["evictions"] == 2
+
+
+# --------------------------------------------------------------------- #
+# payload checksum units (plain numpy)
+
+
+def _fake_payload(seed=0):
+    rng = np.random.default_rng(seed)
+    arrays = {
+        "k_pool": rng.standard_normal((4, 2, 8)).astype(np.float32),
+        "v_pool": rng.standard_normal((4, 2, 8)).astype(np.float32),
+    }
+    key = ((-1,), (1, 2, 3, 4))
+    return BlockPayload(
+        key=key, index=0, arrays=arrays,
+        crc=payload_checksum(key, 0, arrays),
+    )
+
+
+def test_payload_checksum_seals_identity_and_bytes():
+    p = _fake_payload()
+    assert verify_payload(p)
+    # the identity is part of the digest: the same bytes cannot be
+    # replayed under a different chain address or chain position
+    assert payload_checksum(p.key, 1, p.arrays) != p.crc
+    assert payload_checksum(((-1,), (9, 9, 9, 9)), 0, p.arrays) != p.crc
+    # a reshape (same bytes, different layout) fails, not just bit flips
+    reshaped = {k: v.reshape(4, 16) for k, v in p.arrays.items()}
+    assert payload_checksum(p.key, 0, reshaped) != p.crc
+    assert p.nbytes == sum(a.nbytes for a in p.arrays.values())
+
+
+def test_corrupt_payload_is_detected():
+    p = _fake_payload()
+    corrupt_payload(p)  # flips one byte AFTER sealing
+    assert not verify_payload(p)
+
+
+# --------------------------------------------------------------------- #
+# the tentpole oracle: transferred prefix == recomputed prefix, bitwise
+
+
+def test_transfer_bitwise_identical_to_recompute(lm_and_params):
+    model, params = lm_and_params
+    src = _mk_replica(model, params, 0)
+    dst = _mk_replica(model, params, 1)
+    ref = _mk_replica(model, params, 2)
+    try:
+        expected = _serve(src, PROMPT)  # also primes src's prefix cache
+
+        payloads = _export(src, PROMPT)
+        assert len(payloads) == 3
+        assert [p.index for p in payloads] == [0, 1, 2]
+        assert all(verify_payload(p) for p in payloads)
+        # chain keys nest: each key embeds its parent (content chaining)
+        assert payloads[1].key[0] == payloads[0].key
+        assert payloads[2].key[0] == payloads[1].key
+
+        res = _import(dst, payloads)
+        assert res == {
+            "accepted": 3, "rejected": 0,
+            "bytes": sum(p.nbytes for p in payloads),
+        }
+        dst._kv.check_invariants()
+        assert all(dst._kv.is_cached(p.key) for p in payloads)
+
+        # the decode side actually USES the imported blocks (admission
+        # sees 3 shared blocks) and emits the same tokens as a replica
+        # that computed everything itself
+        assert _serve(dst, PROMPT) == expected
+        assert dst._hit_blocks == 3
+        assert _serve(ref, PROMPT) == expected
+        dst._kv.check_invariants()
+
+        # ... and re-exporting from the importer reproduces the SAME
+        # payloads bit for bit: transfers compose without drift
+        payloads2 = _export(dst, PROMPT)
+        assert [p.key for p in payloads2] == [p.key for p in payloads]
+        assert [p.crc for p in payloads2] == [p.crc for p in payloads]
+        for a, b in zip(payloads, payloads2):
+            assert sorted(a.arrays) == sorted(b.arrays)
+            for name in a.arrays:
+                assert np.array_equal(a.arrays[name], b.arrays[name])
+    finally:
+        src.close(), dst.close(), ref.close()
+
+
+def test_corrupt_block_rejected_chain_dropped_tokens_unchanged(lm_and_params):
+    model, params = lm_and_params
+    src = _mk_replica(model, params, 0)
+    mid = _mk_replica(model, params, 1)
+    first = _mk_replica(model, params, 2)
+    try:
+        expected = _serve(src, PROMPT)
+
+        # corrupt the MIDDLE of the chain: the verified prefix before it
+        # lands, the corrupt block and its descendants are dropped
+        payloads = _export(src, PROMPT)
+        corrupt_payload(payloads[1])
+        res = _import(mid, payloads)
+        assert res["accepted"] == 1 and res["rejected"] == 1
+        assert mid._kv.is_cached(payloads[0].key)
+        assert not mid._kv.is_cached(payloads[1].key)
+        assert not mid._kv.is_cached(payloads[2].key)
+        mid._kv.check_invariants()
+        assert _serve(mid, PROMPT) == expected  # suffix recomputed
+        assert mid._hit_blocks == 1
+
+        # corrupt the FIRST block: nothing lands at all
+        payloads = _export(src, PROMPT)
+        corrupt_payload(payloads[0])
+        res = _import(first, payloads)
+        assert res["accepted"] == 0 and res["rejected"] == 1
+        first._kv.check_invariants()
+        assert _serve(first, PROMPT) == expected  # full local recompute
+        assert first._hit_blocks == 0
+    finally:
+        src.close(), mid.close(), first.close()
+
+
+def test_import_into_cache_disabled_pool_is_a_noop(lm_and_params):
+    """adopt_block refuses when prefix caching is off — the import
+    accepts nothing, rejects nothing, and the request recomputes."""
+    model, params = lm_and_params
+    src = _mk_replica(model, params, 0)
+    dst = _mk_replica(model, params, 1, prefix_cache=False)
+    try:
+        expected = _serve(src, PROMPT)
+        res = _import(dst, _export(src, PROMPT))
+        assert res == {"accepted": 0, "rejected": 0, "bytes": 0}
+        dst._kv.check_invariants()
+        assert _serve(dst, PROMPT) == expected
+    finally:
+        src.close(), dst.close()
+
+
+def test_import_is_first_writer_wins(lm_and_params):
+    """Blocks the destination already holds are SKIPPED, not clobbered
+    — a local prefill that beat the transfer keeps its blocks."""
+    model, params = lm_and_params
+    src = _mk_replica(model, params, 0)
+    dst = _mk_replica(model, params, 1)
+    try:
+        _serve(src, PROMPT)
+        _serve(dst, PROMPT)  # dst prefilled the prefix itself already
+        used_before = dst._kv.blocks_in_use
+        res = _import(dst, _export(src, PROMPT))
+        assert res == {"accepted": 0, "rejected": 0, "bytes": 0}
+        assert dst._kv.blocks_in_use == used_before  # no blocks adopted
+        dst._kv.check_invariants()
+    finally:
+        src.close(), dst.close()
+
+
+# --------------------------------------------------------------------- #
+# cross-tenant isolation: namespaced prefixes never transfer
+
+
+def test_cross_namespace_prefix_never_exports(lm_and_params):
+    model, params = lm_and_params
+    src = _mk_replica(model, params, 0)
+    try:
+        _serve(src, PROMPT)  # registered under the base namespace (-1)
+        assert len(_export(src, PROMPT, namespace=-1)) == 3
+        # the SAME tokens under another tenant's namespace own nothing:
+        # the chain keys are namespace-seeded, so there is no block a
+        # cross-tenant transfer could even address
+        assert src._kv.cached_chain(PROMPT, namespace=7) == []
+        assert _export(src, PROMPT, namespace=7) == []
+        assert FleetCacheDirectory.key_of(PROMPT, 4, namespace=7) != \
+            FleetCacheDirectory.key_of(PROMPT, 4, namespace=-1)
+    finally:
+        src.close()
+
+
+# --------------------------------------------------------------------- #
+# verbs refuse dead/closed schedulers (the _die ordering contract)
+
+
+def test_export_refuses_closed_and_dead_schedulers(lm_and_params):
+    model, params = lm_and_params
+    sched = _mk_replica(model, params, 0)
+    sched.close()
+    with pytest.raises(RuntimeError):
+        sched.export_kv_prefix(PROMPT)
+    with pytest.raises(RuntimeError):
+        sched.import_kv_blocks([])
+
+    dead = _mk_replica(model, params, 1)
+    try:
+        fut = dead.export_kv_prefix(PROMPT)
+        dead.hard_kill(fault.DeviceLostError("chaos: replica dies"))
+        dead.tick()  # processes the death; queued verbs must FAIL, not hang
+        with pytest.raises(Exception):
+            fut.result(timeout=5)
+        with pytest.raises(RuntimeError):
+            dead.export_kv_prefix(PROMPT)
+    finally:
+        dead.close()
+
+
+# --------------------------------------------------------------------- #
+# fleet-membership coherence (ISSUE 19 satellite): a retired replica's
+# directory entries are evicted BEFORE its drain starts
+
+
+def test_remove_replica_evicts_its_directory_entries(lm_and_params):
+    model, params = lm_and_params
+    r0 = _mk_replica(model, params, 0, prefix_cache=False)
+    r1 = _mk_replica(model, params, 1, prefix_cache=False)
+    router = FleetRouter(
+        [r0, r1], base_rng=jax.random.PRNGKey(42),
+        heartbeat_timeout_s=None, start_monitor=False,
+    )
+    fleet = ServingFleet([r0, r1], router)
+    try:
+        directory = FleetCacheDirectory()
+        fleet.cache_directory = directory
+        k_retiree = (-1, (1, 2, 3, 4))
+        k_survivor = (-1, (5, 6, 7, 8))
+        directory.publish(k_retiree, 1)
+        directory.publish(k_survivor, 0)
+
+        fleet.remove_replica(1)
+
+        # the retiree's entry is gone; the survivor's is untouched — and
+        # placement can no longer name the retiree, so a directory hit
+        # can never route a transfer at a replica that cannot export
+        assert directory.lookup(k_retiree) is None
+        assert directory.lookup(k_survivor) == 0
+        assert len(directory) == 1
+        assert router.peek_placement(PROMPT) == 0
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# DisaggFleet config validation
+
+
+def test_disagg_config_validation(lm_and_params):
+    model, params = lm_and_params
+    r0 = _mk_replica(model, params, 0, prefix_cache=False)
+    router = FleetRouter(
+        [r0], base_rng=jax.random.PRNGKey(0),
+        heartbeat_timeout_s=None, start_monitor=False,
+    )
+    fleet = ServingFleet([r0], router)
+    try:
+        cases = [
+            {"enabled": False},
+            {"bogus_key": 1},
+            {"transfer_deadline_ms": 0},
+            {"transfer_workers": 0},
+            {"prefill_replicas": 0},
+        ]
+        for dcfg in cases:
+            with pytest.raises(ValueError):
+                DisaggFleet(fleet, disagg=dcfg, prefill_replicas=[object()])
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------- #
+# the coordinator end to end: threaded replicas, async staging workers
+
+
+def test_disagg_coordinator_end_to_end(lm_and_params):
+    model, params = lm_and_params
+    fault.reset_counters()
+    # two prefix groups x two requests: the suffix differs, the first
+    # block is shared, so the second request of each group rides the
+    # directory entry its twin published
+    prompts = [
+        np.concatenate([PROMPT[:4], np.array(sfx, np.int32)])
+        for sfx in ([5, 6, 7, 8, 9], [10, 11, 12], [5, 6, 7, 8, 9], [10, 11, 12])
+    ]
+    ref = _mk_replica(model, params, 9)
+    expected = [_serve(ref, p) for p in prompts]
+    ref.close()
+
+    decode = [
+        _mk_replica(model, params, i, start=True) for i in range(2)
+    ]
+    prefill = _mk_replica(model, params, 100, start=True)
+    router = FleetRouter(
+        decode, base_rng=jax.random.PRNGKey(42),
+        heartbeat_timeout_s=None, start_monitor=False,
+    )
+    fleet = ServingFleet(decode, router)
+    disagg = DisaggFleet(
+        fleet,
+        disagg={"transfer_deadline_ms": 60_000.0, "transfer_workers": 1},
+        prefill_replicas=[prefill],
+    )
+    try:
+        streams = {i: [] for i in range(len(prompts))}
+        futs = []
+        for i, p in enumerate(prompts):
+            futs.append(disagg.submit(
+                p, on_token=lambda t, i=i: streams[i].append(int(t))
+            ))
+        got = [list(map(int, f.result(timeout=120)["tokens"])) for f in futs]
+        assert got == expected  # token-identical through the transfer tier
+        assert [streams[i] for i in range(len(prompts))] == expected
+
+        counters = fault.counters()
+        assert counters.get("serving_disagg_transfers", 0) >= 1
+        snap = disagg.snapshot()
+        assert snap["disagg"]["transfers"] >= 1
+        assert snap["disagg"]["directory"]["entries"] >= 1
+        assert snap["disagg"]["prefill_replicas"] == 1
+        for sched in decode:
+            sched._kv.check_invariants()
+    finally:
+        disagg.close()
+
+    # thread hygiene: the disagg-xfer workers and every replica loop are
+    # gone after close
+    leaked = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(("disagg-", "serving-scheduler", "fleet-monitor"))
+    ]
+    assert not leaked, f"leaked threads: {leaked}"

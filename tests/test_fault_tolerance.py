@@ -269,6 +269,8 @@ def test_unknown_fault_kind_names_the_valid_kinds():
                  "sdc_flip", "ckpt_corrupt",
                  "serve_nan", "serve_raise", "serve_device_lost", "serve_hang",
                  "replica_down", "replica_hang",
+                 "kv_transfer_stall", "kv_transfer_corrupt",
+                 "prefill_replica_down",
                  "ckpt_fail", "restore_fail", "ckpt_async_fail"):
         assert kind in msg, f"{kind!r} missing from the error menu: {msg}"
 
