@@ -800,6 +800,12 @@ def bench_serve():
                               that the continuous scheduler removes
       BENCH_SERVE_SCHEDULER   1/0: force serving.scheduler.enabled on/off,
                               overriding the config — the A/B switch
+      BENCH_SERVE_ASYNC_DEPTH scheduler path only: override
+                              serving.scheduler.async_depth (0 = sync tick
+                              loop) — the deferred-readback A/B switch; the
+                              record carries tick_host_ms / dispatch-gap
+                              percentiles so the host-overhead delta is
+                              visible next to the throughput delta
     """
     import numpy as np
 
@@ -819,6 +825,15 @@ def bench_serve():
         sched_cfg = dict(cfg["serving"].get("scheduler") or {})
         sched_cfg["enabled"] = sched_env not in ("0", "false", "")
         cfg["serving"]["scheduler"] = sched_cfg
+    async_env = os.environ.get("BENCH_SERVE_ASYNC_DEPTH")
+    if async_env is not None:
+        sched_cfg = dict(cfg["serving"].get("scheduler") or {})
+        sched_cfg["async_depth"] = int(async_env)
+        cfg["serving"]["scheduler"] = sched_cfg
+    # captured before the engine consumes (pops) the scheduler block
+    async_depth = int(
+        (cfg["serving"].get("scheduler") or {}).get("async_depth", 0)
+    )
     rng = np.random.default_rng(0)
 
     with InferenceEngine.from_config(cfg) as engine:
@@ -912,6 +927,27 @@ def bench_serve():
                     }
                     if "prefill_tokens_per_sec" in snap
                     else {}
+                ),
+                # async decode pipeline (round 15): host bookkeeping per
+                # tick + accelerator idle gap between decode dispatches —
+                # the two numbers async_depth > 0 is supposed to move
+                **(
+                    {
+                        "async_depth": async_depth,
+                        "tick_host_ms_p50": round(
+                            snap["tick_host_ms_p50"], 3
+                        ),
+                        "tick_host_ms_p99": round(
+                            snap["tick_host_ms_p99"], 3
+                        ),
+                        "dispatch_gap_ms_p50": round(
+                            snap["decode_dispatch_gap_ms_p50"], 3
+                        ),
+                        "dispatch_gap_ms_p99": round(
+                            snap["decode_dispatch_gap_ms_p99"], 3
+                        ),
+                    }
+                    if "tick_host_ms_p50" in snap else {}
                 ),
     }
     print(json.dumps(record))

@@ -72,6 +72,17 @@ tick):
                        watchdog must fire and convert the stall into a
                        diagnosed restart
 
+Lagged guard semantics under the async decode pipeline
+(``serving.scheduler.async_depth > 0``): the injection still lands at
+tick T's DISPATCH, but its observable consequence moves to the drain of
+that step — up to ``async_depth`` ticks later.  ``serve_nan``'s
+non-finite flag is read at drain time (eviction one-or-more ticks late,
+attribution unchanged); ``serve_raise`` surfaces when the dispatch
+itself runs, and the supervisor drains the in-flight ring
+(``flush_async``) before poison-bisecting so the sync probe sees a
+state-consistent pool.  The isolation contract is identical either way:
+exactly the faulted request fails, survivors stay bit-exact.
+
 Fleet-side kinds (the ``step`` is the fleet router's monitor POLL index,
 1-based — serving/router.py consults the injector once per health sweep):
 

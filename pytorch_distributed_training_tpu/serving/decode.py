@@ -236,6 +236,14 @@ class _PagedFns:
     step for every slot; the scheduler's host loop supplies fresh inputs
     per iteration, so this one program serves any mix of in-flight
     requests.  In quant mode ``params`` here is the int8 tree.
+    ``decode_step_fed(params, pool, prev_tok, fresh_mask, fresh_tok, pos,
+    block_tables, row_keys, gen_index, adapter_ids)`` — the async-pipeline
+    twin of ``decode_step``: ``prev_tok`` is the PREVIOUS step's on-device
+    token output fed back without a host round-trip, and rows whose last
+    token the host knows better (just prefilled, refilled, or replayed)
+    are spliced in-graph via ``where(fresh_mask, fresh_tok, prev_tok)``.
+    Output carry (tok) is a valid ``prev_tok`` input to itself, so step
+    k+1 can be dispatched before step k's tokens are read back.
     ``finite`` [B] bool is the on-device output guard: True iff every
     logit the row sampled from is finite — the serving mirror of the
     training anomaly guard, letting the scheduler evict a NaN-producing
@@ -254,23 +262,27 @@ class _PagedFns:
     the apply: correct flax cache paths, no throwaway compile).
     """
 
-    def __init__(self, prefill, decode_step, init_pool, verify, copy_rows):
+    def __init__(self, prefill, decode_step, init_pool, verify, copy_rows,
+                 decode_step_fed):
         self.prefill = prefill
         self.decode_step = decode_step
         self.init_pool = init_pool
         self.verify = verify
         self.copy_rows = copy_rows
+        self.decode_step_fed = decode_step_fed
 
     def _cache_size(self) -> int:
         """Distinct XLA programs compiled across all phases — the
         scheduler's compile count is bounded by the bucket grid for
-        prefill plus ONE program each for decode/verify/copy, independent
-        of traffic."""
+        prefill plus ONE program each for decode/verify/copy (plus one
+        for the self-feeding async decode step, compiled only when the
+        pipeline is enabled), independent of traffic."""
         return (
             self.prefill._cache_size()
             + self.decode_step._cache_size()
             + self.verify._cache_size()
             + self.copy_rows._cache_size()
+            + self.decode_step_fed._cache_size()
         )
 
 
@@ -354,6 +366,25 @@ def build_paged_fns(
         return tok, jnp.isfinite(logits[:, 0]).all(axis=-1), variables["cache"]
 
     @jax.jit
+    def decode_step_fed(
+        params, pool, prev_tok, fresh_mask, fresh_tok, pos, block_tables,
+        row_keys, gen_index, adapter_ids=None,
+    ):
+        if quant:
+            params = dequantize_tree(params, jnp.float32)
+        # prev_tok is the previous step's ON-DEVICE token output; rows the
+        # host just (re)filled get their known last token spliced in here,
+        # so the pipeline never needs a host round-trip to mix fresh rows
+        # into the carried batch
+        prev = jnp.where(fresh_mask, fresh_tok, prev_tok)
+        logits, variables = _apply(
+            params, pool, prev[:, None], pos[:, None], block_tables,
+            adapter_ids,
+        )
+        tok = sample(logits[:, 0], _token_keys(row_keys, gen_index))
+        return tok, jnp.isfinite(logits[:, 0]).all(axis=-1), variables["cache"]
+
+    @jax.jit
     def verify(params, pool, tokens, positions, block_tables, adapter_ids=None):
         logits, variables = _apply(
             params, pool, tokens, positions, block_tables, adapter_ids
@@ -394,4 +425,6 @@ def build_paged_fns(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes
         )
 
-    return _PagedFns(prefill, decode_step, init_pool, verify, copy_rows)
+    return _PagedFns(
+        prefill, decode_step, init_pool, verify, copy_rows, decode_step_fed
+    )

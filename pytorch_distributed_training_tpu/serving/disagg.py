@@ -51,6 +51,14 @@ returns immediately and the worker stages blocks onto the replica
 to the caller's future.  The staging path is wrapped whole in the
 degrade-to-colocated net: any exception inside it is accounting, not an
 error the client sees.
+
+The HOST half of an export — device→host block copies plus the CRC
+seal — runs on a separate bounded ``kv-staging`` executor
+(``serving.disagg.staging_workers`` / ``staging_chunk_rows``), not on
+the source scheduler's loop thread: the scheduler only dispatches lazy
+device slices (``kv_transfer.extract_block_refs``) at a tick boundary,
+so exporting a prefix no longer stalls the exporter's own decode
+dispatch behind numpy copies.
 """
 from __future__ import annotations
 
@@ -194,6 +202,8 @@ class DisaggFleet:
         deadline_ms = float(dcfg.pop("transfer_deadline_ms", 2000.0))
         capacity = int(dcfg.pop("directory_capacity", 4096))
         workers = int(dcfg.pop("transfer_workers", 2))
+        staging_workers = int(dcfg.pop("staging_workers", 1))
+        staging_chunk = dcfg.pop("staging_chunk_rows", None)
         if dcfg:
             raise ValueError(f"unknown serving.disagg keys: {sorted(dcfg)}")
         if deadline_ms <= 0:
@@ -202,6 +212,14 @@ class DisaggFleet:
             )
         if workers < 1:
             raise ValueError(f"transfer_workers must be >= 1, got {workers}")
+        if staging_workers < 1:
+            raise ValueError(
+                f"staging_workers must be >= 1, got {staging_workers}"
+            )
+        if staging_chunk is not None and int(staging_chunk) < 1:
+            raise ValueError(
+                f"staging_chunk_rows must be >= 1, got {staging_chunk}"
+            )
         if n_prefill < 1:
             raise ValueError(
                 f"serving.disagg.prefill_replicas must be >= 1, got {n_prefill}"
@@ -224,6 +242,21 @@ class DisaggFleet:
         self._exec = ThreadPoolExecutor(
             max_workers=workers,
             thread_name_prefix="disagg-xfer",
+        )
+        # host-staging executor: the device→host block copies + CRC seal
+        # of an export run HERE, not on the source scheduler's loop
+        # thread — the scheduler only dispatches lazy device slices
+        # (kv_transfer.extract_block_refs) at a tick boundary, so a
+        # transfer no longer steals decode-dispatch time from the
+        # prefill replica it exports from.  Bounded separately from the
+        # transfer coordinators so a burst of staging work queues rather
+        # than fanning out across every core.
+        self._staging_chunk = (
+            int(staging_chunk) if staging_chunk is not None else None
+        )
+        self._stage_exec = ThreadPoolExecutor(
+            max_workers=staging_workers,
+            thread_name_prefix="kv-staging",
         )
         self._lock = threading.Lock()
         self._xfer_no = 0  # transfer ordinal (1-based) — the fault clock
@@ -332,6 +365,7 @@ class DisaggFleet:
                 return
             self._closed = True
         self._exec.shutdown(wait=True)
+        self._stage_exec.shutdown(wait=True)
         for i, rep in enumerate(self.prefill_replicas):
             try:
                 rep.close()
@@ -453,16 +487,22 @@ class DisaggFleet:
         self._bump("transfers")
         t0 = time.perf_counter()
         try:
-            payloads = source.export_kv_prefix(
+            refs = source.export_kv_refs(
                 prompt, namespace=-1, stall_s=stall_s,
             ).result(timeout=self.transfer_deadline_s)
-            if not payloads:
+            if not refs:
                 # the source LRU-evicted the prefix between directory
                 # lookup and export: recompute, and unpublish the holder
                 if holder is not None:
                     self.directory.evict_replica(holder)
                 self._bump("transfer_recomputes")
                 return
+            # host staging (device→host copies + CRC) on the bounded
+            # kv-staging executor — the scheduler thread only paid the
+            # device slice dispatch above
+            payloads = self._stage_exec.submit(
+                kv_transfer.materialize_payloads, refs, self._staging_chunk,
+            ).result(timeout=self.transfer_deadline_s)
             if corrupt is not None:
                 kv_transfer.corrupt_payload(payloads[0])
                 self.logger.warning(

@@ -253,6 +253,7 @@ class InferenceEngine:
                 quant=use_quant,
                 lora=self.lora_registry,
                 speculative=spec,
+                async_depth=int(sched_cfg.pop("async_depth", 0)),
                 logger=self.logger,
                 replica_id=replica_id,
                 heartbeat_path=heartbeat_path,
@@ -486,6 +487,160 @@ class InferenceEngine:
             "live": True,
             "queue_depth": self.batcher.depth(),
         }
+
+    def warmup(self) -> Dict[str, float]:
+        """Compile every program the engine can ever run, NOW.
+
+        A freshly restored engine pays its XLA compiles on first traffic
+        — which is exactly when an autoscaler scale-up needs the new
+        replica to absorb load, so cold-compile latency lands in client
+        TTFT at the worst possible moment.  Warmup drives one throwaway
+        call through each (batch-bucket × seq-bucket) prefill program and
+        each decode-phase program instead: scheduler-path calls use
+        all-``-1`` positions (every pool scatter drops — the OOB idiom)
+        and DISCARD the returned pool, so the live pool is never mutated
+        and the call is safe even against a running scheduler thread.
+
+        Returns ``{"warmup_ms", "programs"}`` (programs = compile-count
+        delta, 0 when everything was already warm — warmup is
+        idempotent).  ``ServingFleet.add_replica`` calls this before
+        routing traffic to a new replica and publishes the wall time as
+        the ``scale_up_ready_ms`` gauge.
+        """
+        import time
+
+        t0 = time.perf_counter()
+        before = self.compile_count()
+        if not self.is_lm:
+            self._warmup_classify()
+        elif self.scheduler is not None:
+            self._warmup_scheduler()
+        else:
+            self._warmup_batcher()
+        warmed = self.compile_count() - before
+        ms = (time.perf_counter() - t0) * 1000.0
+        self.logger.info(
+            "engine warmup: %d program(s) compiled in %.0f ms", warmed, ms
+        )
+        return {"warmup_ms": ms, "programs": float(warmed)}
+
+    def _warmup_scheduler(self) -> None:
+        sched = self.scheduler
+        pad_key = sched._pad_key
+        T = sched.table_blocks
+        for bb in sched.batch_buckets:
+            keys = jnp.stack([pad_key] * bb)
+            gi = np.zeros((bb,), np.int32)
+            aids = np.full((bb,), -1, np.int32)
+            last_col = np.zeros((bb,), np.int32)
+            tables = np.zeros((bb, T), np.int32)
+            for sb in sched.seq_buckets:
+                tok, _, _pool = sched._fns.prefill(
+                    sched.params, sched._pool,
+                    np.zeros((bb, sb), np.int32),
+                    np.full((bb, sb), -1, np.int32),
+                    tables, last_col, keys, gi, aids,
+                )
+                jax.block_until_ready(tok)
+        W = sched.slots_n
+        pos = np.full((W,), -1, np.int32)
+        dtables = np.zeros((W, T), np.int32)
+        dgi = np.zeros((W,), np.int32)
+        daids = np.full((W,), -1, np.int32)
+        dkeys = jnp.stack([pad_key] * W)
+        dparams = sched._qparams if sched._quant else sched.params
+        tok, _, _pool = sched._fns.decode_step(
+            dparams, sched._pool, np.zeros((W,), np.int32), pos, dtables,
+            dkeys, dgi, daids,
+        )
+        jax.block_until_ready(tok)
+        if sched._async_depth:
+            # _zero_carry matches the program's own token-output sharding,
+            # so this single call covers both the first dispatch and the
+            # steady-state carried-token dispatch (one cache entry)
+            tok, _, _pool = sched._fns.decode_step_fed(
+                dparams, sched._pool, sched._zero_carry(),
+                np.zeros((W,), bool), np.zeros((W,), np.int32), pos,
+                dtables, dkeys, dgi, daids,
+            )
+            jax.block_until_ready(tok)
+        if sched._spec is not None:
+            self._warmup_speculative(sched)
+
+    def _warmup_speculative(self, sched) -> None:
+        """The speculative round's extra programs: the verify scorer and
+        the fork's row copy on the target side, plus the draft model's
+        own prefill/decode set over the draft pool."""
+        W = sched.slots_n
+        T = sched.table_blocks
+        k = sched._spec.k
+        pad_keys = jnp.stack([sched._pad_key] * W)
+        aids = np.full((W,), -1, np.int32)
+        logits, _pool = sched._fns.verify(
+            sched.params, sched._pool,
+            np.zeros((W, k + 1), np.int32),
+            np.full((W, k + 1), -1, np.int32),
+            np.zeros((W, T), np.int32), aids,
+        )
+        jax.block_until_ready(logits)
+        n_rows = sched._kv.num_blocks * sched._kv.block_size
+        oob = np.full((W * sched._kv.block_size,), n_rows, np.int32)
+        jax.block_until_ready(sched._fns.copy_rows(sched._pool, oob, oob))
+        for bb in sched.batch_buckets:
+            keys = jnp.stack([sched._pad_key] * bb)
+            for sb in sched.seq_buckets:
+                tok, _, _pool = sched._draft_fns.prefill(
+                    sched._draft_params, sched._draft_pool,
+                    np.zeros((bb, sb), np.int32),
+                    np.full((bb, sb), -1, np.int32),
+                    np.zeros((bb, T), np.int32),
+                    np.zeros((bb,), np.int32), keys,
+                    np.zeros((bb,), np.int32), np.full((bb,), -1, np.int32),
+                )
+                jax.block_until_ready(tok)
+        tok, _, _pool = sched._draft_fns.decode_step(
+            sched._draft_params, sched._draft_pool,
+            np.zeros((W,), np.int32), np.full((W,), -1, np.int32),
+            np.zeros((W, T), np.int32), pad_keys,
+            np.zeros((W,), np.int32), aids,
+        )
+        jax.block_until_ready(tok)
+
+    def _warmup_batcher(self) -> None:
+        """Batcher-path warmup: one (prefill, decode) execution per
+        (batch, seq) bucket pair through the exact ``_run_lm`` shapes.
+        Decode here actually runs its while_loop (bounded by
+        ``max_new_tokens``) — warmup cost is dominated by the compiles
+        it exists to front-load."""
+        tok_sh = batch_sharding(self.mesh, 2)
+        row_sh = batch_sharding(self.mesh, 1)
+        rng = jax.random.PRNGKey(0)
+        dp = (
+            self.params if self._decode_params is None
+            else self._decode_params
+        )
+        for bb in self.batch_buckets:
+            plen = jax.device_put(np.ones((bb,), np.int32), row_sh)
+            for sb in self.seq_buckets:
+                carry = self._generate.prefill(
+                    self.params,
+                    jax.device_put(np.zeros((bb, sb), np.int32), tok_sh),
+                    plen, rng,
+                )
+                out, _gen = self._generate.decode(dp, plen, carry)
+                jax.block_until_ready(out)
+
+    def _warmup_classify(self) -> None:
+        for bb in self.batch_buckets:
+            img = np.zeros(
+                (bb, self.image_size, self.image_size, 3), np.float32
+            )
+            jax.block_until_ready(
+                self._classify(
+                    self.params, self.batch_stats,
+                    jax.device_put(img, batch_sharding(self.mesh, 4)),
+                )
+            )
 
     def install_drain_handler(self, signum=None) -> None:
         """Route SIGTERM (or ``signum``) to a graceful :meth:`drain`.

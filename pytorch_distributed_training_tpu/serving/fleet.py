@@ -213,8 +213,12 @@ class ServingFleet:
         """Scale up by one replica, built by the stored factory from the
         SAME config resolution as the original fleet (checkpoint is not
         re-read).  Returns the new replica's stable index.  The replica
-        joins placement immediately — callers wanting a warm cache
-        submit a priming request themselves."""
+        is WARMED before it joins placement — ``InferenceEngine.warmup``
+        compiles every (prefill-bucket × decode) program up front, so the
+        first routed request never pays cold-compile TTFT; the
+        construction-to-warm wall time lands in the replica's
+        ``scale_up_ready_ms`` gauge (prefix-cache priming stays the
+        caller's job)."""
         if self.replica_factory is None:
             raise RuntimeError(
                 "fleet has no replica_factory (build via from_config, or "
@@ -226,8 +230,17 @@ class ServingFleet:
                 raise RuntimeError("fleet is closed")
             rid = self._next_replica_id
             self._next_replica_id = rid + 1
+        t0 = time.monotonic()
         rep = self.replica_factory(rid)
         try:
+            if hasattr(rep, "warmup"):
+                rep.warmup()
+            ready_ms = (time.monotonic() - t0) * 1000.0
+            if hasattr(rep, "metrics"):
+                rep.metrics.record_scale_up_ready(ready_ms)
+            self.logger.info(
+                "replica %d warm in %.0f ms (construction + compile)",
+                rid, ready_ms)
             idx = self.router.add_replica(rep)
         except BaseException:
             rep.close()

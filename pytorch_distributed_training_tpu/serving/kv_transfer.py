@@ -28,15 +28,18 @@ from __future__ import annotations
 
 import zlib
 from dataclasses import dataclass
-from typing import Any, Dict, List, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 __all__ = [
     "BlockPayload",
+    "BlockRef",
     "corrupt_payload",
+    "extract_block_refs",
     "extract_payloads",
+    "materialize_payloads",
     "payload_checksum",
     "pool_row_leaves",
     "scatter_payloads",
@@ -103,35 +106,105 @@ class BlockPayload:
         return sum(int(a.nbytes) for a in self.arrays.values())
 
 
-def extract_payloads(
+@dataclass
+class BlockRef:
+    """One block SELECTED for transfer but not yet host-staged: lazy
+    per-leaf device slices instead of materialized numpy rows.
+
+    The split exists so the scheduler thread only pays the cheap device
+    slice dispatch (``leaf[rows]`` — an async device gather, no host
+    sync) and the expensive part — device→host copies plus the CRC seal
+    — runs on a staging executor (serving/disagg.py).  Safety: the
+    slices are taken at a tick boundary while the pool is quiescent, and
+    JAX arrays are immutable, so the snapshot stays valid even after the
+    scheduler functionally replaces its pool on later ticks.
+    """
+
+    key: tuple
+    index: int  # position of this block in the prefix chain, 0-based
+    slices: Dict[str, Any]  # leaf name -> [block_size, ...] device rows
+
+
+def extract_block_refs(
     kv, pool, prompt: Sequence[int], namespace=None
-) -> List[BlockPayload]:
-    """Gather the longest cached chain for ``prompt`` into payloads.
+) -> List[BlockRef]:
+    """Select the longest cached chain for ``prompt`` as lazy refs.
 
     Runs on the source scheduler's loop thread (single-thread pool
-    confinement): the cache's own reference keeps every chain block
-    alive for the duration of the host copy, so no refcounts are taken.
-    Cached blocks are fully written by construction — registration is
-    capped at ``(prompt_len - 1) // block_size`` FULL blocks.
+    confinement) but does NOT block on any host copy.  Cached blocks are
+    fully written by construction — registration is capped at
+    ``(prompt_len - 1) // block_size`` FULL blocks.
     """
     chain = kv.cached_chain(prompt, namespace)
     if not chain:
         return []
     bs = kv.block_size
     leaves = pool_row_leaves(pool, kv.num_blocks * bs)
+    return [
+        BlockRef(
+            key=key,
+            index=index,
+            slices={
+                name: leaf[blk * bs : (blk + 1) * bs] for name, leaf in leaves
+            },
+        )
+        for index, (key, blk) in enumerate(chain)
+    ]
+
+
+def materialize_payloads(
+    refs: Sequence[BlockRef], chunk_rows: Optional[int] = None
+) -> List[BlockPayload]:
+    """Host-stage refs into CRC-sealed payloads (any thread).
+
+    This is the expensive half of an export — the device→host copies and
+    the checksum over every byte.  ``chunk_rows`` bounds each individual
+    ``np.asarray`` to that many leading rows (None = whole leaf slice in
+    one copy): with several transfers sharing one bounded staging
+    executor, chunking keeps any single copy from monopolizing a worker
+    and caps the transient host buffer per copy.
+    """
+    if chunk_rows is not None and chunk_rows < 1:
+        raise ValueError(f"chunk_rows must be >= 1, got {chunk_rows}")
     out: List[BlockPayload] = []
-    for index, (key, blk) in enumerate(chain):
-        rows = slice(blk * bs, (blk + 1) * bs)
-        arrays = {name: np.asarray(leaf[rows]) for name, leaf in leaves}
+    for ref in refs:
+        arrays: Dict[str, np.ndarray] = {}
+        for name, sl in ref.slices.items():
+            n = sl.shape[0]
+            if chunk_rows is None or chunk_rows >= n:
+                arrays[name] = np.asarray(sl)
+            else:
+                arrays[name] = np.concatenate(
+                    [
+                        np.asarray(sl[i : i + chunk_rows])
+                        for i in range(0, n, chunk_rows)
+                    ]
+                )
         out.append(
             BlockPayload(
-                key=key,
-                index=index,
+                key=ref.key,
+                index=ref.index,
                 arrays=arrays,
-                crc=payload_checksum(key, index, arrays),
+                crc=payload_checksum(ref.key, ref.index, arrays),
             )
         )
     return out
+
+
+def extract_payloads(
+    kv, pool, prompt: Sequence[int], namespace=None
+) -> List[BlockPayload]:
+    """Gather the longest cached chain for ``prompt`` into payloads.
+
+    The synchronous composition of :func:`extract_block_refs` and
+    :func:`materialize_payloads` — the staging cost lands on the calling
+    thread.  The disaggregated transfer path splits the two phases
+    instead (refs on the scheduler thread, staging on the coordinator's
+    executor); this stays for callers that want a one-shot export.
+    """
+    return materialize_payloads(
+        extract_block_refs(kv, pool, prompt, namespace=namespace)
+    )
 
 
 def verify_payload(payload: BlockPayload) -> bool:

@@ -61,6 +61,18 @@ class ServingMetrics:
         self._kv_transfer_ms = self._registry.histogram(
             "kv_transfer_ms", _RESERVOIR
         )
+        # async decode pipeline (PR 20): per-tick host overhead (tick
+        # wall minus device-readback waits) and the host gap between
+        # consecutive decode dispatch enqueues — the pair that makes the
+        # pipeline win observable instead of inferred: async mode should
+        # shrink the dispatch gap toward pure bookkeeping cost while
+        # tick_host_ms stays flat
+        self._tick_host_ms = self._registry.histogram(
+            "tick_host_ms", _RESERVOIR
+        )
+        self._dispatch_gap_ms = self._registry.histogram(
+            "decode_dispatch_gap_ms", _RESERVOIR
+        )
         self._items = 0  # guarded by: self._lock
         self._first_t: Optional[float] = None  # guarded by: self._lock
         self._last_t: Optional[float] = None  # guarded by: self._lock
@@ -88,6 +100,10 @@ class ServingMetrics:
         # 3.4% acceptance rate.  0.0 disables the gate.
         self.spec_min_acceptance = 0.0
         self._spec_floor_warned = False  # guarded by: self._lock
+        # autoscaler scale-up readiness: wall ms from replica construction
+        # to warm (every program compiled) — set once by the fleet's
+        # add_replica after InferenceEngine.warmup()
+        self._scale_up_ready_ms: Optional[float] = None  # guarded by: self._lock
 
     def adapter_name(self, adapter: str, name: str) -> str:
         """Registry name for adapter-scoped instrument ``name``."""
@@ -219,6 +235,27 @@ class ServingMetrics:
         self._slot_occ.observe(active_slots / max(total_slots, 1))
         self._block_util.observe(blocks_in_use / max(total_blocks, 1))
 
+    def record_tick(self, host_ms: float) -> None:
+        """One scheduler tick's HOST overhead: wall time minus the spans
+        spent blocked on device readbacks — what the accelerator idles
+        through between dispatches on the sync path."""
+        self._tick_host_ms.observe(float(host_ms))
+
+    def record_dispatch_gap(self, gap_ms: float) -> None:
+        """Host wall time between two consecutive decode dispatch
+        enqueues during back-to-back decode ticks.  The sync path's gap
+        includes the full readback + bookkeeping window; the async
+        pipeline's is bookkeeping only."""
+        self._dispatch_gap_ms.observe(float(gap_ms))
+
+    def record_scale_up_ready(self, ms: float) -> None:
+        """Wall ms from replica construction to warm (all programs
+        compiled) at autoscaler scale-up — the cold-compile TTFT a
+        warmed ``add_replica`` no longer pays on first traffic."""
+        with self._lock:
+            self._scale_up_ready_ms = float(ms)
+        self._registry.gauge("scale_up_ready_ms").set(float(ms))
+
     def record_kv_transfer(
         self, *, nbytes: int, seconds: float, blocks: int
     ) -> None:
@@ -310,6 +347,22 @@ class ServingMetrics:
         if xfer["count"]:
             out["kv_transfer_ms_p50"] = float(xfer["p50"])
             out["kv_transfer_ms_p99"] = float(xfer["p99"])
+        # async-pipeline observability (absent until a tick/dispatch-gap
+        # sample lands, keeping batcher-path snapshots byte-stable)
+        tick = self._tick_host_ms.snapshot()
+        if tick["count"]:
+            out["tick_host_ms_p50"] = float(tick["p50"])
+            out["tick_host_ms_p99"] = float(tick["p99"])
+            out["tick_host_ms_mean"] = float(tick["mean"])
+        gap = self._dispatch_gap_ms.snapshot()
+        if gap["count"]:
+            out["decode_dispatch_gap_ms_p50"] = float(gap["p50"])
+            out["decode_dispatch_gap_ms_p99"] = float(gap["p99"])
+            out["decode_dispatch_gap_ms_mean"] = float(gap["mean"])
+        with self._lock:
+            ready_ms = self._scale_up_ready_ms
+        if ready_ms is not None:
+            out["scale_up_ready_ms"] = float(ready_ms)
         counters = self._registry.counters()
         hits = counters.get("prefix_hit_blocks", 0)
         misses = counters.get("prefix_miss_blocks", 0)
@@ -379,6 +432,9 @@ _AGG_SUM = ("requests", "batches", "items", "gen_tokens")
 _AGG_MAX = (
     "latency_ms_p50", "latency_ms_p99", "max_queue_depth",
     "block_util_max", "kv_transfer_ms_p50", "kv_transfer_ms_p99",
+    "tick_host_ms_p50", "tick_host_ms_p99",
+    "decode_dispatch_gap_ms_p50", "decode_dispatch_gap_ms_p99",
+    "scale_up_ready_ms",
 )
 
 

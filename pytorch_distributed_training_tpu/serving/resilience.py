@@ -136,6 +136,10 @@ class ServingSupervisor:
         with the chained cause.
         """
         sched = self._sched
+        # the scheduler flushed its async dispatch ring before handing
+        # us the failure (scheduler.tick), so probe/replay state below
+        # is sync-equivalent: host-known streams match the device, and
+        # dispatch counters are rolled back to gen_idx.
         if not _is_device_loss(exc) and sched._tick_phase == "decode":
             # span = the serve-side MTTR anchor (telemetry/slo.py): recovery
             # start → first post-recovery decode tick

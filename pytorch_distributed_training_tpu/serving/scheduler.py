@@ -41,6 +41,17 @@ optional tick watchdog (engine/watchdog.py) turns a hung step into a
 diagnosed restart.  The ``serve_*`` kinds in engine/fault.py drive all
 of it deterministically.
 
+Async decode pipeline (``async_depth > 0``, default-off): the sync loop
+above pays one full host round-trip per token — ``np.asarray(tok)``
+before the next dispatch — so the device idles for the whole host
+bookkeeping window every single-token step.  With a depth set, the
+sampled-token carry stays ON DEVICE (``decode_step_fed`` feeds its own
+output back as the next ``prev_tok``) and a bounded in-flight ring
+drains host readbacks one tick behind dispatch; host bookkeeping stays
+exact through per-request ``dispatched`` counters and the drained
+stream is bitwise token-identical to the sync path (greedy and
+sampled).  See :meth:`ContinuousScheduler._decode_step_async`.
+
 Single-process by design (for now): inputs are handed to jit uncommitted
 rather than sharded over the mesh — multi-host serving stays on the
 batcher path until the scheduler learns sharded block tables.
@@ -86,7 +97,7 @@ class _PagedRequest:
     __slots__ = (
         "prompt", "max_new", "future", "enqueued_at", "deadline",
         "on_token", "row_key", "admission", "slot", "tokens", "poison",
-        "adapter", "adapter_name", "draft_admission",
+        "adapter", "adapter_name", "draft_admission", "dispatched",
     )
 
     def __init__(self, prompt, max_new, deadline, on_token, row_key):
@@ -104,6 +115,13 @@ class _PagedRequest:
         self.adapter = -1  # LoRA adapter id; -1 = base model
         self.adapter_name: Optional[str] = None
         self.draft_admission = None  # speculative mode: draft-pool blocks
+        # async pipeline: generated tokens DETERMINED so far — drained
+        # into ``tokens`` plus steps still in the in-flight ring.  The
+        # host derives every dispatch input (position, sampling index)
+        # from this counter, so only the token VALUE needs to stay on
+        # device.  Invariant: dispatched >= len(tokens); equal in sync
+        # mode and whenever the ring is empty for this row.
+        self.dispatched = 0
 
     @property
     def gen_idx(self) -> int:
@@ -143,6 +161,7 @@ class ContinuousScheduler:
         quant: bool = False,
         lora=None,
         speculative=None,
+        async_depth: int = 0,
         logger: Optional[logging.Logger] = None,
         start: bool = True,
         replica_id: Optional[int] = None,
@@ -231,6 +250,24 @@ class ContinuousScheduler:
                 "accept rule is exact only against the argmax stream (the "
                 "sampled accept rule is serving/speculative.py's "
                 "sampled_accept, not yet wired to the scheduler)"
+            )
+        # async decode pipeline (default-off): depth of the in-flight
+        # dispatch ring.  0 = today's synchronous loop (read every step's
+        # tokens back before dispatching the next); N >= 1 keeps up to N
+        # dispatched steps un-drained, with the sampled-token carry fed
+        # back ON DEVICE (decode_step_fed) so the accelerator never waits
+        # out the host's per-token bookkeeping window.
+        self._async_depth = int(async_depth)
+        if self._async_depth < 0:
+            raise ValueError(
+                f"async_depth must be >= 0, got {async_depth}"
+            )
+        if self._async_depth and self._spec is not None:
+            raise ValueError(
+                "async_depth and speculative decoding are mutually "
+                "exclusive: a speculative round's host accept/reject "
+                "must observe every verify result before the next round "
+                "can be proposed, so there is nothing to pipeline"
             )
         # speculative branch forking reserves ONE private spare block per
         # request on top of its footprint (the CoW target for the
@@ -323,6 +360,17 @@ class ContinuousScheduler:
         # read them cross-thread as best-effort diagnostics
         self._tick_no = 0  # confined: _loop
         self._tick_phase = ""  # confined: _loop
+
+        # async-pipeline state (all confined: _loop).  _inflight holds
+        # (tok_dev, finite_dev, rows) per dispatched-but-undrained step;
+        # _carry_tok is the LAST dispatch's on-device token vector — the
+        # next step's prev_tok input.  _last_dispatch/_tick_block_s feed
+        # the decode_dispatch_gap_ms / tick_host_ms histograms.
+        self._inflight: deque = deque()  # confined: _loop
+        self._carry_tok = None  # confined: _loop
+        # (tick_no, perf_counter) of the latest decode dispatch
+        self._last_dispatch: Optional[tuple] = None  # confined: _loop
+        self._tick_block_s = 0.0  # confined: _loop
 
         res = dict(resilience or {})
         wd = dict(res.pop("watchdog", None) or {})
@@ -478,6 +526,7 @@ class ContinuousScheduler:
             req.adapter_name = adapter
             if replay:
                 req.tokens = replay
+                req.dispatched = len(replay)
             self._queue.append(req)
             self.metrics.observe_depth(len(self._queue))
             self._cond.notify_all()
@@ -641,6 +690,32 @@ class ContinuousScheduler:
             self._cond.notify_all()
         return fut
 
+    def export_kv_refs(
+        self,
+        prompt: Sequence[int],
+        namespace=None,
+        stall_s: Optional[float] = None,
+    ) -> Future:
+        """Stage ``prompt``'s cached prefix blocks as LAZY refs (any thread).
+
+        Resolves to a list of :class:`kv_transfer.BlockRef` — the cheap
+        half of an export.  Only the device slice dispatch runs on the
+        scheduler thread; the caller materializes the refs into
+        CRC-sealed payloads (``kv_transfer.materialize_payloads``) on its
+        own executor, keeping the device→host copies and checksum work
+        off the scheduler loop entirely.  This is the disaggregated
+        transfer path's verb; :meth:`export_kv_prefix` keeps the one-shot
+        payload contract.
+        """
+        fut: Future = Future()
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        with self._cond:
+            if self._closed or self._dead:
+                raise RuntimeError("cannot export KV from a closed scheduler")
+            self._xfer_q.append(("export_refs", (arr, namespace, stall_s), fut))
+            self._cond.notify_all()
+        return fut
+
     def import_kv_blocks(self, payloads) -> Future:
         """Adopt transferred blocks into the local prefix cache (any thread).
 
@@ -742,7 +817,21 @@ class ContinuousScheduler:
             self._watchdog.step_started(self._tick_no)
         try:
             try:
+                # tick_host_ms = tick wall minus time BLOCKED on device
+                # readbacks (the decode paths accumulate their np.asarray
+                # waits into _tick_block_s) — the host-overhead number the
+                # async pipeline exists to hide
+                self._tick_block_s = 0.0
+                t_tick0 = time.perf_counter()
                 did = self._tick_inner()
+                if did:
+                    self.metrics.record_tick(
+                        max(
+                            time.perf_counter() - t_tick0
+                            - self._tick_block_s,
+                            0.0,
+                        ) * 1000.0
+                    )
             finally:
                 if self._watchdog is not None:
                     self._watchdog.step_finished()
@@ -762,6 +851,12 @@ class ContinuousScheduler:
                 "scheduler tick %d failed in phase %r; invoking supervisor",
                 self._tick_no, self._tick_phase,
             )
+            # async pipeline: settle the in-flight dispatch ring BEFORE
+            # recovery.  The supervisor's bisect probes and replays
+            # assume sync-equivalent host state, and a step that was
+            # merely in flight when an unrelated row poisoned the tick
+            # must not confound attribution.  No-op in sync mode.
+            self.flush_async()
             return self._supervisor.handle_tick_failure(exc)
 
     def _tick_inner(self) -> bool:
@@ -798,6 +893,8 @@ class ContinuousScheduler:
             self._tick_phase = "decode"
             if self._spec is not None:
                 self._spec_decode_step()
+            elif self._async_depth:
+                self._decode_step_async()
             else:
                 self._decode_step()
         self._publish_pool_gauges()
@@ -870,6 +967,8 @@ class ContinuousScheduler:
             try:
                 if verb == "export":
                     res = self._export_kv(*arg)
+                elif verb == "export_refs":
+                    res = self._export_kv_refs(*arg)
                 else:
                     res = self._import_kv(arg)
             except Exception as exc:
@@ -894,6 +993,19 @@ class ContinuousScheduler:
             )
             time.sleep(float(stall_s))
         return payloads
+
+    def _export_kv_refs(self, prompt, namespace, stall_s):
+        refs = kv_transfer.extract_block_refs(
+            self._kv, self._pool, prompt, namespace=namespace
+        )
+        if refs:
+            self._bump("kv_transfer_exported_blocks", len(refs))
+        if stall_s is not None:
+            self.logger.warning(
+                "fault injection: kv transfer export stalled %.2fs", stall_s
+            )
+            time.sleep(float(stall_s))
+        return refs
 
     def _import_kv(self, payloads):
         t0 = time.perf_counter()
@@ -1076,9 +1188,11 @@ class ContinuousScheduler:
             self.params, self._pool, tokens, positions, tables,
             last_col, jnp.stack(keys), np.zeros((bb,), np.int32), aids,
         )
+        rb0 = time.perf_counter()
         tok = np.asarray(tok)
         finite = np.asarray(finite)
         t1 = time.perf_counter()
+        self._tick_block_s += t1 - rb0
         for i, req in enumerate(newly):
             if not finite[i]:
                 # output guard: this prompt produced non-finite logits —
@@ -1355,6 +1469,7 @@ class ContinuousScheduler:
         self._poison_shim(active)
         prev, pos, tables, gen_idx, aids, keys = self._decode_arrays(active)
         n_active = len(active)
+        self._note_dispatch_gap()
         # the span marks this tick as PRODUCTIVE serving work — the
         # serve-side MTTR endpoint (telemetry/slo.py pairs it with the
         # preceding poison_bisect/serving_restart recovery span)
@@ -1364,9 +1479,11 @@ class ContinuousScheduler:
                 self._pool, prev, pos, tables,
                 jnp.stack(keys), gen_idx, aids,
             )
+        rb0 = time.perf_counter()
         tok = np.asarray(tok)
         finite = np.asarray(finite)
         t1 = time.perf_counter()
+        self._tick_block_s += t1 - rb0
         for req in active:
             if not finite[req.slot]:
                 # on-device output guard: evict the NaN emitter, every
@@ -1397,6 +1514,198 @@ class ContinuousScheduler:
         )
         # surface async dispatch errors here, inside the probe's try
         jax.block_until_ready(tok)
+
+    # ------------------------------------------------------------------ #
+    # async decode pipeline (serving.scheduler.async_depth > 0)
+
+    def _note_dispatch_gap(self) -> None:
+        """Record the host-side gap between consecutive decode dispatch
+        enqueues — the number the pipeline exists to shrink.  Only gaps
+        between BACK-TO-BACK decode ticks count: an idle queue between
+        two dispatches is not host overhead."""
+        now = time.perf_counter()
+        if (
+            self._last_dispatch is not None
+            and self._tick_no - self._last_dispatch[0] <= 1
+        ):
+            self.metrics.record_dispatch_gap(
+                (now - self._last_dispatch[1]) * 1000.0
+            )
+        self._last_dispatch = (self._tick_no, now)
+
+    def _decode_step_async(self) -> None:
+        """Pipelined decode: dispatch step *k* without waiting for step
+        *k-1*'s host readback.
+
+        The sampled-token carry stays ON DEVICE — ``decode_step_fed``
+        feeds its own token output back as the next ``prev_tok``, with
+        rows the host just (re)filled spliced in via ``fresh_mask`` — and
+        a ring of up to ``async_depth`` dispatched steps drains one tick
+        behind dispatch.  Host state stays exact without the tokens: the
+        per-request ``dispatched`` counter derives every position and
+        sampling index, so the drained stream is bitwise identical to
+        the sync path's (same per-row fold_in keys, same per-row pool
+        writes in the same order).
+
+        Lag consequences, all bounded by ``async_depth``: retire/refill
+        and the NaN output guard observe tokens late, so a row can
+        execute past EOS — never past ``max_new`` (the dispatch cap is
+        host-exact) — and those overrun writes land at positions
+        ``<= prompt_len + max_new - 2``, inside the row's reserved
+        footprint; the sampled overrun tokens are discarded at drain
+        because the request has already retired (``admission is None``),
+        and once its blocks recycle, any stale overrun rows are masked
+        exactly like every other recycled-block row.
+        """
+        active = [req for req in self._slots if req is not None]
+        self._poison_shim(active)
+        # host-exact dispatch cap: a row never dispatches past its token
+        # budget, so only EOS (host-unknown until drain) can overrun
+        disp = [r for r in active if r.dispatched < r.max_new]
+        if disp:
+            W = self.slots_n
+            fresh_mask = np.zeros((W,), bool)
+            fresh_tok = np.zeros((W,), np.int32)
+            pos = np.full((W,), -1, np.int32)
+            tables = np.zeros((W, self.table_blocks), np.int32)
+            gen_idx = np.zeros((W,), np.int32)
+            aids = np.full((W,), -1, np.int32)
+            keys = [self._pad_key] * W
+            rows = []
+            for req in disp:
+                i = req.slot
+                d = req.dispatched
+                if d == req.gen_idx:
+                    # nothing of this row is in flight: its last token is
+                    # host-known (fresh prefill, refill, or post-recovery
+                    # rollback) and overrides the stale carry in-graph
+                    fresh_mask[i] = True
+                    fresh_tok[i] = req.tokens[-1]
+                pos[i] = req.prompt.size + d - 1
+                ids = self._table_ids(req)
+                tables[i, : len(ids)] = ids
+                gen_idx[i] = d
+                aids[i] = req.adapter
+                keys[i] = req.row_key
+                rows.append((req, i, d))
+            prev = self._carry_tok
+            if prev is None:
+                # first dispatch of a pipeline run: every dispatched row
+                # is fresh by construction, the zeros are never sampled
+                prev = self._zero_carry()
+            self._note_dispatch_gap()
+            with span("decode_step", step=self._tick_no, active=len(disp)):
+                tok, finite, self._pool = self._fns.decode_step_fed(
+                    self._qparams if self._quant else self.params,
+                    self._pool, prev, fresh_mask, fresh_tok, pos, tables,
+                    jnp.stack(keys), gen_idx, aids,
+                )
+            for req in disp:
+                req.dispatched += 1
+            self._carry_tok = tok
+            self._inflight.append((tok, finite, rows))
+            self.metrics.record_iteration(
+                active_slots=len(disp), total_slots=self.slots_n,
+                blocks_in_use=self._kv.blocks_in_use,
+                total_blocks=self._kv.num_blocks,
+            )
+        # drain one tick behind dispatch (ring bounded at async_depth);
+        # when nothing is left to dispatch, drain EVERYTHING so the
+        # endgame cannot strand determined tokens in flight
+        target = self._async_depth if disp else 0
+        pushed = 0
+        t0 = time.perf_counter()
+        while len(self._inflight) > target:
+            pushed += self._drain_entry(self._inflight.popleft())
+        t1 = time.perf_counter()
+        self._tick_block_s += t1 - t0
+        if pushed:
+            self.metrics.record_decode(n_tokens=pushed, decode_s=t1 - t0)
+
+    def _zero_carry(self):
+        """A mesh-replicated, COMMITTED int32[slots] zeros vector whose
+        sharding matches ``decode_step_fed``'s token output.
+
+        The jit cache keys on input shardings: feeding an uncommitted
+        ``jnp.zeros`` as the first carry and the committed program output
+        as every later one would compile the SAME program twice (one
+        re-layout entry).  Matching the output's replicated NamedSharding
+        up front keeps the async path at exactly one compiled program —
+        the compile-count pin the tests hold."""
+        z = jnp.zeros((self.slots_n,), jnp.int32)
+        leaf_sh = getattr(
+            jax.tree_util.tree_leaves(self.params)[0], "sharding", None
+        )
+        if isinstance(leaf_sh, jax.sharding.NamedSharding):
+            z = jax.device_put(
+                z,
+                jax.sharding.NamedSharding(
+                    leaf_sh.mesh, jax.sharding.PartitionSpec()
+                ),
+            )
+        return z
+
+    def _drain_entry(self, entry) -> int:
+        """Materialize one ring entry's host readback and apply it.
+
+        Rows whose request already left its slot (EOS overrun after a
+        lagged retire, poison eviction, hot-restart requeue) or whose
+        host stream was rolled back since dispatch are discarded — their
+        token was never part of the committed stream.  Returns the
+        number of tokens pushed."""
+        tok_dev, finite_dev, rows = entry
+        tok = np.asarray(tok_dev)
+        finite = np.asarray(finite_dev)
+        pushed = 0
+        for req, slot, idx in rows:
+            if req.admission is None or idx != req.gen_idx:
+                continue
+            if not finite[slot]:
+                # the on-device output guard, observed async_depth ticks
+                # late: the emitter's own table re-reads its NaN rows
+                # every overrun step, so the flag stays false and the
+                # eviction lands on exactly this request
+                self._evict_poisoned(
+                    req, cause=None, trigger="non-finite decode logits"
+                )
+                continue
+            self._push_token(req, int(tok[slot]))
+            pushed += 1
+        return pushed
+
+    def flush_async(self) -> None:
+        """Drain what the in-flight ring can still deliver, discard the
+        rest, and roll every live row's dispatch counter back to its
+        host-known stream.
+
+        ``tick`` calls this on any failure BEFORE invoking the
+        supervisor: probes and replays assume sync-equivalent host state
+        (``_decode_probe`` re-dispatches from ``tokens[-1]``), and
+        attribution must not blame a request for a step that was merely
+        in flight when an unrelated row poisoned the tick.  Runs on the
+        tick thread only.  Discarded steps cost nothing —
+        re-dispatching them reproduces the same tokens and the same
+        idempotent pool writes.  No-op in sync mode (the ring is empty).
+        """
+        while self._inflight:
+            entry = self._inflight.popleft()
+            try:
+                self._drain_entry(entry)
+            except Exception:
+                # the device state behind the remaining entries is part
+                # of the same failure — discard, the rollback below makes
+                # re-dispatch exact
+                self.logger.warning(
+                    "async ring drain failed mid-recovery; discarding %d "
+                    "remaining in-flight step(s)", len(self._inflight),
+                )
+                self._inflight.clear()
+                break
+        self._carry_tok = None
+        self._last_dispatch = None
+        for req in self._slots:
+            if req is not None:
+                req.dispatched = req.gen_idx
 
     # ------------------------------------------------------------------ #
     # speculative decoding (serving/speculative.py)
@@ -1511,7 +1820,9 @@ class ContinuousScheduler:
             logits, self._pool = self._fns.verify(
                 self.params, self._pool, ver_tok, ver_pos, vtables, aids,
             )
+            rb0 = time.perf_counter()
             logits = np.asarray(logits)
+            self._tick_block_s += time.perf_counter() - rb0
 
         # -- host accept/reject + commit -------------------------------
         t1 = time.perf_counter()
@@ -1558,6 +1869,8 @@ class ContinuousScheduler:
 
     def _push_token(self, req: _PagedRequest, tok: int) -> None:
         req.tokens.append(tok)
+        if req.dispatched < len(req.tokens):
+            req.dispatched = len(req.tokens)
         if req.on_token is not None:
             try:
                 req.on_token(tok)
@@ -1638,6 +1951,9 @@ class ContinuousScheduler:
         """A device error poisons every in-flight request (their pool
         state is unknown); queued requests are failed too rather than
         retried into the same error."""
+        # in-flight async steps die with the requests they belong to
+        self._inflight.clear()
+        self._carry_tok = None
         with self._cond:
             doomed = [s for s in self._slots if s is not None]
             doomed.extend(self._queue)
@@ -1666,6 +1982,12 @@ class ContinuousScheduler:
         push every in-flight request back onto the queue head (FCFS order
         preserved) for replay admission.  Queued requests ride along
         untouched.  Runs on the scheduler thread (inside tick's except)."""
+        # the ring indexes the dead pool/programs: discard it outright
+        # (the requeued requests replay their host-known streams, and the
+        # discarded steps' tokens were never delivered)
+        self._inflight.clear()
+        self._carry_tok = None
+        self._last_dispatch = None
         with self._cond:
             inflight = [s for s in self._slots if s is not None]
             self._slots = [None] * self.slots_n
@@ -1675,6 +1997,7 @@ class ContinuousScheduler:
                 req.admission = None
                 req.draft_admission = None
                 req.slot = -1
+                req.dispatched = req.gen_idx
                 self._queue.appendleft(req)
         self._fns = build_paged_fns(
             self._model, self._block_size, self._num_blocks,

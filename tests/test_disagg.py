@@ -402,6 +402,8 @@ def test_disagg_config_validation(lm_and_params):
             {"transfer_deadline_ms": 0},
             {"transfer_workers": 0},
             {"prefill_replicas": 0},
+            {"staging_workers": 0},
+            {"staging_chunk_rows": 0},
         ]
         for dcfg in cases:
             with pytest.raises(ValueError):
@@ -471,3 +473,62 @@ def test_disagg_coordinator_end_to_end(lm_and_params):
         if t.name.startswith(("disagg-", "serving-scheduler", "fleet-monitor"))
     ]
     assert not leaked, f"leaked threads: {leaked}"
+
+
+# --------------------------------------------------------------------- #
+# two-phase export (refs on the scheduler thread, staging off-thread)
+
+
+def test_block_refs_materialize_equal_one_shot_export(lm_and_params):
+    """extract_block_refs + materialize_payloads == extract_payloads,
+    byte for byte (keys, CRCs, arrays) — with and without chunked
+    copies — and the refs survive the source pool being replaced
+    (immutability snapshot, the property the async staging relies on)."""
+    model, params = lm_and_params
+    sched = _mk_replica(model, params, 0)
+    try:
+        _serve(sched, PROMPT)
+        one_shot = kv_transfer.extract_payloads(
+            sched._kv, sched._pool, PROMPT, namespace=-1
+        )
+        assert len(one_shot) == 3  # (13 - 1) // 4 full blocks
+        refs = kv_transfer.extract_block_refs(
+            sched._kv, sched._pool, PROMPT, namespace=-1
+        )
+        # decode MORE traffic so the scheduler functionally replaces its
+        # pool before the refs are materialized
+        _serve(sched, PROMPT[:7])
+        for chunk_rows in (None, 1, 3):
+            staged = kv_transfer.materialize_payloads(refs, chunk_rows)
+            assert [p.key for p in staged] == [p.key for p in one_shot]
+            assert [p.crc for p in staged] == [p.crc for p in one_shot]
+            for a, b in zip(staged, one_shot):
+                assert sorted(a.arrays) == sorted(b.arrays)
+                for name in a.arrays:
+                    np.testing.assert_array_equal(
+                        a.arrays[name], b.arrays[name]
+                    )
+                assert kv_transfer.verify_payload(a)
+        with pytest.raises(ValueError, match="chunk_rows"):
+            kv_transfer.materialize_payloads(refs, 0)
+    finally:
+        sched.close()
+
+
+def test_export_kv_refs_verb_matches_payload_export(lm_and_params):
+    """The scheduler's export_kv_refs queue verb yields refs whose
+    staged payloads match export_kv_prefix's, and bumps the exported
+    counter the same way."""
+    model, params = lm_and_params
+    sched = _mk_replica(model, params, 0)
+    try:
+        _serve(sched, PROMPT)
+        full = _export(sched, PROMPT)
+        fut = sched.export_kv_refs(PROMPT, namespace=-1)
+        sched.tick()
+        refs = fut.result(timeout=5)
+        staged = kv_transfer.materialize_payloads(refs)
+        assert [p.crc for p in staged] == [p.crc for p in full]
+        assert sched.metrics.snapshot()["kv_transfer_exported_blocks"] == 6
+    finally:
+        sched.close()

@@ -1296,3 +1296,153 @@ def test_bench_serve_artifact_rounds_no_clobber(tmp_path, monkeypatch):
         assert _json.load(f)["value"] == 1  # untouched
     monkeypatch.setenv("BENCH_SERVE_PERSIST", "0")
     assert bench._persist_serve_artifact({"mode": "serve"}) is None
+
+
+# --------------------------------------------------------------------- #
+# async decode pipeline (serving.scheduler.async_depth)
+
+
+def _async_mixed_case(lm_and_params, temperature, depth):
+    """Run the same mixed workload sync and async: 6 prompts through 2
+    slots (refill happens while the pipeline is full), mixed gen-lens via
+    per-request caps and EOS retirement."""
+    model, params = lm_and_params
+    rng = np.random.default_rng(11)
+    lens = [2, 6, 4, 3, 5, 2]
+    prompts = [rng.integers(2, VOCAB, ln).astype(np.int32) for ln in lens]
+    caps = [None, 2, None, 1, 3, None]
+    R = jax.random.PRNGKey(7)
+    kwargs = [
+        {
+            "max_new_tokens": caps[i],
+            **({"rng": jax.random.fold_in(R, i)} if temperature else {}),
+        }
+        for i in range(len(prompts))
+    ]
+    out = []
+    for async_depth in (0, depth):
+        sched = _paged_sched(
+            model, params, slots=2, temperature=temperature,
+            async_depth=async_depth,
+        )
+        out.append(_sched_results(sched, prompts, kwargs))
+        sched.close()
+    return out
+
+
+@pytest.mark.parametrize("temperature", [0.0, 0.8], ids=["greedy", "sampled"])
+@pytest.mark.parametrize("depth", [1, 2])
+def test_scheduler_async_parity_bitwise(lm_and_params, temperature, depth):
+    """The deferred-readback pipeline is bitwise token-identical to the
+    sync loop, greedy AND sampled, under mixed gen-lens (per-request
+    caps + EOS) and slot refill mid-pipeline."""
+    sync, pipelined = _async_mixed_case(lm_and_params, temperature, depth)
+    for i, (a, b) in enumerate(zip(sync, pipelined)):
+        assert a["gen_len"] == b["gen_len"], f"request {i} gen_len diverged"
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+
+
+def test_scheduler_async_compile_pin(lm_and_params, mode_prompts,
+                                     plain_sched_results):
+    """The async pipeline adds AT MOST one program over the sync set.
+
+    Under traffic it compiles the same count: ``decode_step_fed``
+    replaces ``decode_step`` one-for-one (the sync program is never
+    invoked when async_depth > 0).  The pin also guards the sharding
+    trap: the first dispatch's zero carry must hit the SAME cache entry
+    as the steady-state carried token, or the fed program doubles."""
+    model, params = lm_and_params
+    _, base_compiles = plain_sched_results
+    sched = _paged_sched(model, params, async_depth=2)
+    _sched_results(sched, mode_prompts)
+    assert sched.compile_count() == base_compiles
+    # more decode traffic must not add programs (carry sharding stable)
+    rng = np.random.default_rng(17)
+    _sched_results(
+        sched, [rng.integers(2, VOCAB, n).astype(np.int32) for n in (5, 3)]
+    )
+    assert sched.compile_count() == base_compiles
+    sched.close()
+
+
+def test_scheduler_async_validation(lm_and_params):
+    """async_depth must be >= 0 and is mutually exclusive with
+    speculative decoding (the accept/reject loop must observe every
+    verify result on the host before the next round)."""
+    from pytorch_distributed_training_tpu.serving.speculative import (
+        SpeculativeSpec,
+    )
+
+    model, params = lm_and_params
+    with pytest.raises(ValueError, match="async_depth"):
+        _paged_sched(model, params, async_depth=-1)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _paged_sched(
+            model, params, async_depth=1, speculative=SpeculativeSpec(k=2),
+        )
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["sync", "async"])
+def test_scheduler_tick_metrics_surface(lm_and_params, mode_prompts, depth):
+    """tick_host_ms / decode_dispatch_gap_ms land in the snapshot on
+    both decode paths (gap samples need back-to-back decode ticks, which
+    any multi-token request produces)."""
+    model, params = lm_and_params
+    sched = _paged_sched(model, params, async_depth=depth)
+    _sched_results(sched, mode_prompts)
+    snap = sched.metrics.snapshot()
+    sched.close()
+    for key in (
+        "tick_host_ms_p50", "tick_host_ms_p99", "tick_host_ms_mean",
+        "decode_dispatch_gap_ms_p50", "decode_dispatch_gap_ms_p99",
+    ):
+        assert key in snap, key
+        assert snap[key] >= 0.0
+
+
+def test_engine_warmup_compiles_everything_up_front(sched_engine):
+    """warmup() compiles the full program set at restore time: traffic
+    after it adds ZERO programs, and a second warmup is a no-op."""
+    first = sched_engine.warmup()
+    assert first["programs"] >= 0  # module-scoped engine may be part-warm
+    warm = sched_engine.compile_count()
+    assert sched_engine.warmup()["programs"] == 0  # idempotent
+    rng = np.random.default_rng(5)
+    futs = [
+        sched_engine.submit(rng.integers(2, VOCAB, n).astype(np.int32))
+        for n in (3, 9, 5)
+    ]
+    for f in futs:
+        assert f.result(timeout=60)["gen_len"] >= 1
+    assert sched_engine.compile_count() == warm
+
+
+def test_fleet_add_replica_warms_and_records_readiness(lm_and_params):
+    """ServingFleet.add_replica warms the new replica before it joins
+    placement and publishes scale_up_ready_ms in its metrics snapshot."""
+    from pytorch_distributed_training_tpu.serving.fleet import ServingFleet
+    from pytorch_distributed_training_tpu.serving.router import FleetRouter
+    from pytorch_distributed_training_tpu.serving.scheduler import (
+        ContinuousScheduler,
+    )
+
+    model, params = lm_and_params
+
+    def factory(rid):
+        return ContinuousScheduler(
+            model, params, slots=2, block_size=4, num_blocks=16,
+            batch_buckets=[2], seq_buckets=[8], max_new_tokens=4,
+            temperature=0.0, start=False, replica_id=rid,
+        )
+
+    r0 = factory(0)
+    router = FleetRouter([r0], base_rng=jax.random.PRNGKey(0),
+                         heartbeat_timeout_s=None, start_monitor=False)
+    fleet = ServingFleet([r0], router, replica_factory=factory)
+    idx = fleet.add_replica()
+    rep = fleet.replicas[idx]
+    snap = rep.metrics.snapshot()
+    assert snap["scale_up_ready_ms"] > 0.0
+    router.shutdown()
+    for r in fleet.replicas:
+        r.close()

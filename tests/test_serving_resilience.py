@@ -640,3 +640,63 @@ def test_hung_tick_becomes_diagnosed_restart(lm_and_params):
     assert snap["serve_watchdog_fires"] >= 1
     assert snap["engine_restarts"] == 1
     sched.close()
+
+
+# --------------------------------------------------------------------- #
+# poison isolation under the async decode pipeline (async_depth > 0):
+# the finite guard / poison shim fire up to async_depth ticks AFTER the
+# faulted dispatch, so eviction happens at DRAIN time — attribution must
+# still name exactly the poisoned request, and the lagged retire must
+# not leak blocks or disturb neighbours.
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_async_poison_isolation_nan_output_guard(lm_and_params, depth):
+    """serve_nan with a full dispatch ring: the non-finite flag is
+    observed one-or-more ticks late at drain, evicts ONLY the poisoned
+    slot, and the survivors stay bitwise equal to a SYNC clean run."""
+    model, params = lm_and_params
+    _, clean = _run_under_spec(model, params, None, prefix_cache=False)
+    ref = [f.result()["tokens"] for f in clean]
+
+    sched, futs = _run_under_spec(
+        model, params, "serve_nan@2:0", prefix_cache=False,
+        async_depth=depth,
+    )
+    errs = [i for i, f in enumerate(futs) if f.exception() is not None]
+    assert errs == [0]
+    exc = futs[0].exception()
+    assert isinstance(exc, PoisonedRequestError)
+    assert "non-finite" in str(exc)
+    assert exc.__cause__ is None  # guard path: nothing ever raised
+    for i in (1, 2):
+        np.testing.assert_array_equal(futs[i].result()["tokens"], ref[i])
+    assert sched._supervisor.restarts() == 0
+    assert sched.metrics.snapshot()["requests_poisoned"] == 1
+    assert sched._kv.blocks_in_use == 0
+
+
+@pytest.mark.parametrize("depth", [1, 2])
+def test_async_poison_isolation_decode_raise(lm_and_params, depth):
+    """serve_raise mid-pipeline: the supervisor drains the in-flight
+    ring (flush_async) BEFORE bisecting, so the sync probe sees a
+    state-consistent pool and convicts exactly the faulted request."""
+    model, params = lm_and_params
+    _, clean = _run_under_spec(model, params, None, prefix_cache=False)
+    ref = [f.result()["tokens"] for f in clean]
+
+    sched, futs = _run_under_spec(
+        model, params, "serve_raise@2:1", prefix_cache=False,
+        async_depth=depth,
+    )
+    errs = [i for i, f in enumerate(futs) if f.exception() is not None]
+    assert errs == [1]
+    exc = futs[1].exception()
+    assert isinstance(exc, PoisonedRequestError)
+    assert isinstance(exc.__cause__, fault.FaultInjectionError)
+    for i in (0, 2):
+        np.testing.assert_array_equal(futs[i].result()["tokens"], ref[i])
+    assert sched._supervisor.restarts() == 0  # isolated, never restarted
+    snap = sched.metrics.snapshot()
+    assert snap["requests_poisoned"] == 1
+    assert sched._kv.blocks_in_use == 0
